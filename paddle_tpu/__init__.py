@@ -1,0 +1,110 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capabilities (reference layer map in /root/repo/SURVEY.md §1).
+
+Compute path: JAX/XLA (eager ops via cached per-primitive dispatch; whole
+programs via paddle_tpu.jit); kernels: jnp/lax + Pallas for fused hot ops;
+parallelism: jax.sharding SPMD over TPU meshes (paddle_tpu.distributed).
+"""
+from __future__ import annotations
+
+# ---- core -----------------------------------------------------------------
+from paddle_tpu.core.tensor import Tensor, Parameter  # noqa: F401
+from paddle_tpu.core.dtype import (  # noqa: F401
+    bool_ as bool, uint8, int8, int16, int32, int64, float16, bfloat16,
+    float32, float64, complex64, complex128, float8_e4m3fn, float8_e5m2,
+    set_default_dtype, get_default_dtype, finfo, iinfo, promote_types,
+)
+from paddle_tpu.core.place import (  # noqa: F401
+    CPUPlace, CUDAPlace, CustomPlace, Place, TPUPlace, get_device,
+    set_device, is_compiled_with_tpu,
+)
+from paddle_tpu.core.generator import seed, default_generator  # noqa: F401
+from paddle_tpu.core.flags import (  # noqa: F401
+    get_flags, set_flags, define_flag,
+)
+
+# ---- ops (flat namespace like paddle.*) -----------------------------------
+from paddle_tpu import ops  # noqa: F401  (patches Tensor methods)
+from paddle_tpu.ops.creation import *  # noqa: F401,F403
+from paddle_tpu.ops.math import *  # noqa: F401,F403
+from paddle_tpu.ops.manipulation import *  # noqa: F401,F403
+from paddle_tpu.ops.logic import *  # noqa: F401,F403
+from paddle_tpu.ops.search import *  # noqa: F401,F403
+from paddle_tpu.ops.linalg import (  # noqa: F401
+    matmul, mm, bmm, mv, dot, cross, multi_dot, norm, dist, cdist, cholesky,
+    cholesky_solve, inverse, solve, det, slogdet, t, einsum,
+)
+from paddle_tpu.ops.random import (  # noqa: F401
+    rand, randn, randint, randint_like, randperm, uniform, normal,
+    standard_normal, bernoulli, multinomial, poisson, rand_like, randn_like,
+)
+
+# ---- autograd -------------------------------------------------------------
+from paddle_tpu import autograd  # noqa: F401
+from paddle_tpu.autograd import (  # noqa: F401
+    no_grad, enable_grad, set_grad_enabled, is_grad_enabled, grad,
+)
+
+# ---- subsystems -----------------------------------------------------------
+from paddle_tpu import nn  # noqa: F401
+from paddle_tpu import optimizer  # noqa: F401
+from paddle_tpu import amp  # noqa: F401
+from paddle_tpu import io  # noqa: F401
+from paddle_tpu import jit  # noqa: F401
+from paddle_tpu.framework.io import save, load  # noqa: F401
+from paddle_tpu.nn.layer.layers import ParamAttr  # noqa: F401
+
+import paddle_tpu.linalg as linalg  # noqa: F401
+import paddle_tpu.fft as fft  # noqa: F401
+import paddle_tpu.signal as signal  # noqa: F401
+
+__version__ = "0.1.0"
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+def get_flags_dict():
+    return get_flags()
+
+
+def device_count():
+    import jax
+    return len(jax.devices())
+
+
+def synchronize():
+    """Block until all queued device work completes (paddle.device.cuda
+    .synchronize equivalent — XLA: block_until_ready on a trivial op)."""
+    import jax
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+def in_dynamic_mode():
+    return True
+
+
+def disable_static(place=None):
+    pass
+
+
+def enable_static():
+    raise NotImplementedError(
+        "legacy static program mode is replaced by paddle_tpu.jit.to_static "
+        "(XLA program capture); see paddle_tpu.static")
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    total = 0
+    trainable = 0
+    for _, p in net.named_parameters():
+        n = p.size
+        total += n
+        if not p.stop_gradient:
+            trainable += n
+    return {"total_params": total, "trainable_params": trainable}

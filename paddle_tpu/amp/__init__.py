@@ -1,0 +1,247 @@
+"""AMP (reference: python/paddle/amp — auto_cast :1014, decorate :1099,
+GradScaler grad_scaler.py:645, op lists amp_lists.py:33).
+
+TPU-native defaults: bf16 first (no loss scaling needed), fp16 supported for
+parity. The auto-cast hook plugs into core.dispatch exactly where the
+generated ad_funcs apply AMP_LOGIC (eager_gen.py:588).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import dispatch as _dispatch
+from paddle_tpu.core import dtype as dtype_mod
+from paddle_tpu.core.tensor import Tensor
+
+# ---- op lists (reference amp_lists.py / imperative/amp_auto_cast.h) -------
+WHITE_LIST = {
+    "matmul", "linear", "bmm", "mm", "mv", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "einsum",
+    "addmm", "scaled_dot_product_attention", "flash_attn_unpadded",
+}
+BLACK_LIST = {
+    "exp", "square", "log", "log2", "log10", "log1p", "mean", "sum", "std",
+    "var", "cos_sim", "softmax", "log_softmax", "cross_entropy",
+    "softmax_with_cross_entropy", "sigmoid_focal_loss", "bce", "bce_logits",
+    "layer_norm", "rms_norm", "batch_norm", "batch_norm_infer", "norm",
+    "cumsum", "logsumexp", "erfinv", "pow", "logcumsumexp", "kl_div",
+    "l1_loss", "mse_loss", "nll_loss", "smooth_l1_loss", "huber_loss",
+    "linspace", "prod", "acos", "asin", "cosh", "sinh", "tan", "atanh",
+    "acosh", "asinh",
+}
+
+
+class _AmpState:
+    def __init__(self):
+        self.enabled = False
+        self.level = "O0"
+        self.dtype = dtype_mod.bfloat16
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_STATE = _AmpState()
+
+
+def _amp_hook(name, arrays):
+    st = _STATE
+    if not st.enabled or st.level == "O0":
+        return arrays
+    white = (WHITE_LIST | st.custom_white) - st.custom_black
+    black = (BLACK_LIST | st.custom_black) - st.custom_white
+    target = jnp.bfloat16 if st.dtype == dtype_mod.bfloat16 else jnp.float16
+
+    def cast_to(arrs, dt):
+        return [a.astype(dt)
+                if jnp.issubdtype(a.dtype, jnp.floating)
+                and a.dtype != jnp.float64 and a.dtype != dt else a
+                for a in arrs]
+
+    if name in white:
+        return cast_to(arrays, target)
+    if name in black:
+        return cast_to(arrays, jnp.float32)
+    if st.level == "O2" and name not in black:
+        return cast_to(arrays, target)
+    # O1 gray list: promote to widest float among inputs
+    f_dtypes = [a.dtype for a in arrays
+                if jnp.issubdtype(a.dtype, jnp.floating)]
+    if len(set(f_dtypes)) > 1:
+        widest = jnp.float32 if jnp.float32 in f_dtypes else target
+        return cast_to(arrays, widest)
+    return arrays
+
+
+_dispatch.set_amp_hook(_amp_hook)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """paddle.amp.auto_cast (amp/auto_cast.py:1014)."""
+    st = _STATE
+    prev = (st.enabled, st.level, st.dtype, st.custom_white, st.custom_black)
+    st.enabled = enable
+    st.level = level if enable else "O0"
+    st.dtype = dtype_mod.convert_dtype(dtype)
+    st.custom_white = set(custom_white_list or ())
+    st.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (st.enabled, st.level, st.dtype, st.custom_white,
+         st.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def is_auto_cast_enabled():
+    return _STATE.enabled
+
+
+def get_amp_dtype():
+    return "bfloat16" if _STATE.dtype == dtype_mod.bfloat16 else "float16"
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False):
+    """paddle.amp.decorate (auto_cast.py:1099): O2 casts the model params
+    to the AMP dtype; optimizer gets fp32 master weights."""
+    d = dtype_mod.convert_dtype(dtype)
+    model_list = models if isinstance(models, (list, tuple)) else [models]
+    if level == "O2":
+        for m in model_list:
+            m.astype(d)
+        if optimizers is not None:
+            opt_list = optimizers if isinstance(optimizers, (list, tuple)) \
+                else [optimizers]
+            for opt in opt_list:
+                opt._multi_precision = True if master_weight is None \
+                    else master_weight
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """Loss scaling (reference amp/grad_scaler.py:645 + the device-side
+    check_finite_and_unscale / update_loss_scaling kernels,
+    phi/kernels/amp_kernel.h:25). With bf16 scaling is a no-op by default
+    (enable=False mirrors reference behavior for bf16)."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 16,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = Tensor._wrap(jnp.asarray(init_loss_scaling,
+                                               jnp.float32))
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return Tensor._wrap(self._scale._data)
+
+    def set_init_loss_scaling(self, v):
+        self._scale._assign_array(jnp.asarray(v, jnp.float32))
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        from paddle_tpu.core.dispatch import run_op
+        s = self._scale
+        return run_op("scale_loss",
+                      lambda a, sc: a * sc.astype(a.dtype), var, s)
+
+    def _unscale(self, optimizer):
+        """check_finite_and_unscale (amp_kernel.h:25) over all grads."""
+        if not self._enable or self._unscaled:
+            return
+        inv = 1.0 / self._scale._data
+        found = jnp.zeros((), jnp.bool_)
+        for p in optimizer._parameter_list:
+            if p.grad is None:
+                continue
+            g32 = p.grad._data.astype(jnp.float32) * inv
+            found = found | ~jnp.isfinite(g32).all()
+            p.grad._assign_array(g32.astype(p.grad._data.dtype))
+        self._found_inf = bool(found)
+        self._unscaled = True
+
+    def unscale_(self, optimizer):
+        return self._unscale(optimizer)
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+        self.update()
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self._unscale(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._unscaled = False
+
+    def update(self):
+        """update_loss_scaling (amp_kernel.h:32)."""
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale._assign_array(
+                    jnp.maximum(self._scale._data * self._decr_ratio, 1.0))
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale._assign_array(
+                    self._scale._data * self._incr_ratio)
+                self._good_steps = 0
+
+    def state_dict(self):
+        return {
+            "scale": np.asarray(self._scale._data),
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_count": self._good_steps,
+            "decr_count": self._bad_steps,
+        }
+
+    def load_state_dict(self, sd):
+        self._scale._assign_array(jnp.asarray(sd["scale"]))
+        self._good_steps = sd.get("incr_count", 0)
+        self._bad_steps = sd.get("decr_count", 0)
+
+
+AmpScaler = GradScaler
+
+
+def is_bfloat16_supported(device=None):
+    return True
+
+
+def is_float16_supported(device=None):
+    return jax.default_backend() != "cpu"

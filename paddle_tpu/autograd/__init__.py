@@ -1,0 +1,218 @@
+"""paddle.autograd equivalent: grad-mode guards, paddle.grad (GeneralGrad,
+eager/general_grad.h), PyLayer (eager/pylayer), functional jacobian/hessian.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import dispatch as _dispatch
+from paddle_tpu.core.tensor import Tensor
+from .tape import Edge, GradNode, run_backward
+
+__all__ = [
+    "backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
+    "is_grad_enabled", "PyLayer", "PyLayerContext", "jacobian", "hessian",
+]
+
+
+class _GradGuard:
+    """Context manager + decorator (paddle.no_grad / enable_grad)."""
+
+    def __init__(self, mode: bool):
+        self._mode = mode
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = _dispatch.set_grad_enabled(self._mode)
+        return self
+
+    def __exit__(self, *exc):
+        _dispatch.set_grad_enabled(self._prev)
+        return False
+
+    def __call__(self, fn):
+        if not callable(fn):
+            raise TypeError("no_grad used as decorator needs a callable")
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with self.__class__():
+                return fn(*a, **k)
+        return wrapper
+
+
+class no_grad(_GradGuard):
+    def __init__(self):
+        super().__init__(False)
+
+
+class enable_grad(_GradGuard):
+    def __init__(self):
+        super().__init__(True)
+
+
+class set_grad_enabled(_GradGuard):
+    def __init__(self, mode: bool):
+        super().__init__(mode)
+
+
+def is_grad_enabled() -> bool:
+    return _dispatch.grad_enabled()
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is not None and not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    run_backward(list(tensors), grad_tensors, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad: partial-graph gradient (reference GeneralGrad,
+    eager/general_grad.h) — returns grads without mutating .grad."""
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+    if retain_graph is None:
+        retain_graph = create_graph
+    captured = run_backward(list(outputs), grad_outputs,
+                            retain_graph=retain_graph, targets=list(inputs),
+                            accumulate_leaf=False)
+    result = []
+    for t in inputs:
+        g = captured.get(id(t))
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "one of the input tensors received no gradient; pass "
+                    "allow_unused=True to get None instead")
+            result.append(None)
+        else:
+            result.append(Tensor._wrap(g, stop_gradient=not create_graph))
+    return result
+
+
+# --------------------------------------------------------------------------
+# PyLayer: user-defined autograd function (reference eager/pylayer +
+# fluid/pybind/eager_py_layer.cc)
+# --------------------------------------------------------------------------
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+        self._non_differentiable = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_non_differentiable(self, *tensors):
+        self._non_differentiable = tensors
+
+    def set_materialize_grads(self, value: bool):
+        self.materialize_grads = bool(value)
+
+
+class PyLayer:
+    """Subclass with static forward(ctx, *args) / backward(ctx, *grads)."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        in_tensors = [a for a in args if isinstance(a, Tensor)]
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outs, (tuple, list))
+        out_list = [outs] if single else list(outs)
+
+        record = _dispatch.grad_enabled() and any(
+            not t.stop_gradient for t in in_tensors)
+        if record:
+            diff_inputs = [t for t in in_tensors
+                           if jnp.issubdtype(t._data.dtype, jnp.inexact)]
+            nondiff_out_ids = {id(t) for t in ctx._non_differentiable}
+            out_t = [t for t in out_list if isinstance(t, Tensor)]
+
+            def vjp_fn(cotangents):
+                cts = [Tensor._wrap(c, True) if not isinstance(c, Tensor)
+                       else c for c in cotangents]
+                with no_grad():
+                    gin = cls.backward(ctx, *cts)
+                gin = [gin] if isinstance(gin, Tensor) or gin is None \
+                    else list(gin)
+                grads = []
+                gi = iter(gin)
+                for t in diff_inputs:
+                    g = next(gi, None)
+                    grads.append(jnp.zeros(t.shape, t.dtype) if g is None
+                                 else (g._data if isinstance(g, Tensor) else g))
+                return tuple(grads)
+
+            edges = []
+            for t in diff_inputs:
+                if t.stop_gradient:
+                    edges.append(None)
+                elif t._grad_node is not None:
+                    edges.append(Edge(node=t._grad_node, out_idx=t._out_idx))
+                else:
+                    edges.append(Edge(leaf=t))
+            avals = [(tuple(t.shape), t._data.dtype) for t in out_t]
+            node = GradNode(cls.__name__, vjp_fn, edges, avals)
+            import weakref
+            for i, t in enumerate(out_t):
+                if id(t) not in nondiff_out_ids:
+                    t.stop_gradient = False
+                    t._grad_node = node
+                    t._out_idx = i
+                    node.out_refs[i] = weakref.ref(t)
+        return out_list[0] if single else tuple(out_list)
+
+
+# --------------------------------------------------------------------------
+# Functional higher-order API (paddle.autograd.jacobian / hessian) — here we
+# delegate straight to jax's transforms over a wrapped pure function.
+# --------------------------------------------------------------------------
+def _as_pure(func):
+    def pure(*arrays):
+        ts = [Tensor._wrap(a, stop_gradient=False) for a in arrays]
+        out = func(*ts)
+        return out._data if isinstance(out, Tensor) else out
+    return pure
+
+
+def jacobian(func, xs, create_graph=False):
+    xs_l = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [x._data for x in xs_l]
+    jac = jax.jacrev(_as_pure(func), argnums=tuple(range(len(arrays))))(*arrays)
+    outs = [Tensor._wrap(j, True) for j in jac]
+    return outs[0] if not isinstance(xs, (list, tuple)) else outs
+
+
+def hessian(func, xs, create_graph=False):
+    xs_l = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [x._data for x in xs_l]
+    hes = jax.hessian(_as_pure(func), argnums=tuple(range(len(arrays))))(*arrays)
+    if not isinstance(xs, (list, tuple)):
+        h = hes[0][0] if isinstance(hes, (tuple, list)) else hes
+        return Tensor._wrap(h, True)
+    return hes

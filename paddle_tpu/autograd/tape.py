@@ -1,0 +1,231 @@
+"""Dygraph autograd engine.
+
+Reference semantics being reproduced (paddle/fluid/eager):
+  - GradNodeBase / generated <Op>GradNode  (eager/grad_node_info.h:197)
+  - GradTensorHolder accumulation          (eager/grad_tensor_holder.h)
+  - queue-based reverse-topological walk   (RunBackward, eager/backward.cc:105)
+  - leaf accumulation + hooks              (eager/accumulation/accumulation_node.h)
+  - partial-graph grad()                   (eager/general_grad.h)
+
+TPU-native design: instead of per-op hand-written backward kernels, each node
+stores the jax.vjp closure of its forward computation; residuals live in
+device (HBM) buffers owned by the closure. The walk itself is host-side and
+identical in structure to the reference engine, so hooks / grad accumulation /
+stop_gradient semantics carry over unchanged.
+"""
+from __future__ import annotations
+
+import weakref
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class Edge:
+    """One autograd edge: where a produced input-gradient flows."""
+
+    __slots__ = ("node", "out_idx", "leaf")
+
+    def __init__(self, node: "GradNode" = None, out_idx: int = 0, leaf=None):
+        self.node = node      # parent GradNode (producer of the input), or None
+        self.out_idx = out_idx
+        self.leaf = leaf      # leaf Tensor (accumulation target), or None
+
+
+class GradNode:
+    """Backward node for one eager op (cf. GradNodeBase, grad_node_info.h:197)."""
+
+    __slots__ = ("name", "vjp_fn", "edges", "out_avals", "out_refs",
+                 "_buf", "_deps", "__weakref__")
+
+    def __init__(self, name: str, vjp_fn, edges: List[Optional[Edge]],
+                 out_avals: List[Tuple[tuple, Any]]):
+        self.name = name
+        self.vjp_fn = vjp_fn              # cotangents -> grads for all primals
+        self.edges = edges                # one entry per primal; None = no grad
+        self.out_avals = out_avals        # [(shape, dtype)] per forward output
+        self.out_refs: List[Optional[weakref.ref]] = [None] * len(out_avals)
+        self._buf = None                  # GradTensorHolder: per-output cotangent
+        self._deps = 0
+
+    # -- execution-time helpers -------------------------------------------
+    def _ensure_buf(self):
+        if self._buf is None:
+            self._buf = [None] * len(self.out_avals)
+
+    def _accumulate(self, idx: int, grad):
+        self._ensure_buf()
+        cur = self._buf[idx]
+        self._buf[idx] = grad if cur is None else cur + grad
+
+    def _cotangents(self):
+        cts = []
+        for i, (shape, dtype) in enumerate(self.out_avals):
+            g = self._buf[i] if self._buf is not None else None
+            if g is None:
+                if jnp.issubdtype(dtype, jnp.inexact):
+                    g = jnp.zeros(shape, dtype)
+                else:
+                    g = np.zeros(shape, jax.dtypes.float0)
+            elif jnp.issubdtype(dtype, jnp.inexact) and g.dtype != dtype:
+                # AMP: an op downstream may accumulate its input-grad in a
+                # different precision (e.g. fp32 master grads into a bf16
+                # output) — vjp wants the cotangent in the output dtype
+                g = g.astype(dtype)
+            cts.append(g)
+        return tuple(cts)
+
+    def __repr__(self):
+        return f"<GradNode {self.name} outs={len(self.out_avals)}>"
+
+
+def _is_float0(g):
+    return getattr(g, "dtype", None) == jax.dtypes.float0
+
+
+def run_backward(tensors, grad_tensors=None, retain_graph=False,
+                 targets=None, accumulate_leaf=True, allow_unused=True):
+    """The reference RunBackward walk (eager/backward.cc:105).
+
+    tensors: root Tensors; grad_tensors: matching initial cotangents (None =
+    ones). If `targets` is given, behaves like GeneralGrad: returns
+    {id(target): grad} and (unless accumulate_leaf) does not touch .grad.
+    """
+    from paddle_tpu.core.tensor import Tensor
+
+    roots = list(tensors)
+    if grad_tensors is None:
+        grad_tensors = [None] * len(roots)
+    grads = []
+    for t, g in zip(roots, grad_tensors):
+        if g is None:
+            g = jnp.ones(t.shape, t.dtype)
+        elif isinstance(g, Tensor):
+            g = g._data
+        grads.append(g)
+
+    captured: Dict[int, Any] = {}
+    target_by_leaf: Dict[int, Any] = {}
+    target_by_slot: Dict[Tuple[int, int], Any] = {}
+    if targets is not None:
+        for tt in targets:
+            if tt._grad_node is not None:
+                target_by_slot[(id(tt._grad_node), tt._out_idx)] = tt
+            else:
+                target_by_leaf[id(tt)] = tt
+
+    # ---- discovery: count in-degrees over the reachable graph ----
+    root_nodes = []
+    seen = set()
+    stack = []
+    for t in roots:
+        n = t._grad_node
+        if n is not None and id(n) not in seen:
+            seen.add(id(n))
+            stack.append(n)
+            root_nodes.append(n)
+    order_nodes = []
+    while stack:
+        n = stack.pop()
+        order_nodes.append(n)
+        for e in n.edges:
+            if e is not None and e.node is not None:
+                if id(e.node) not in seen:
+                    seen.add(id(e.node))
+                    e.node._deps = 0
+                    stack.append(e.node)
+    for n in order_nodes:
+        n._deps = 0
+        n._buf = None
+    for n in order_nodes:
+        for e in n.edges:
+            if e is not None and e.node is not None:
+                e.node._deps += 1
+
+    def _leaf_accumulate(leaf, grad):
+        if _is_float0(grad):
+            return
+        for hook in leaf._grad_hooks:
+            out = hook(Tensor._wrap(grad, stop_gradient=True))
+            if out is not None:
+                grad = out._data if isinstance(out, Tensor) else out
+        if targets is not None and id(leaf) in target_by_leaf:
+            prev = captured.get(id(leaf))
+            captured[id(leaf)] = grad if prev is None else prev + grad
+        if accumulate_leaf:
+            if leaf.grad is None:
+                leaf.grad = Tensor._wrap(grad, stop_gradient=True)
+            else:
+                leaf.grad = Tensor._wrap(leaf.grad._data + grad,
+                                         stop_gradient=True)
+            for hook in leaf._post_acc_hooks:
+                hook(leaf)
+
+    # seed roots
+    for t, g in zip(roots, grads):
+        n = t._grad_node
+        if n is None:
+            if not t.stop_gradient:
+                _leaf_accumulate(t, g)
+            continue
+        n._accumulate(t._out_idx, g)
+
+    queue = deque(n for n in order_nodes if n._deps == 0)
+    ran = set()
+    while queue:
+        node = queue.popleft()
+        if id(node) in ran:
+            continue
+        ran.add(id(node))
+        node._ensure_buf()
+        # per-output tensor hooks (register_hook on non-leaf tensors)
+        for i, ref in enumerate(node.out_refs):
+            if ref is None or node._buf[i] is None:
+                continue
+            t = ref()
+            if t is not None and t._grad_hooks:
+                g = node._buf[i]
+                for hook in t._grad_hooks:
+                    out = hook(Tensor._wrap(g, stop_gradient=True))
+                    if out is not None:
+                        g = out._data if isinstance(out, Tensor) else out
+                node._buf[i] = g
+        if targets is not None:
+            for i in range(len(node.out_avals)):
+                tt = target_by_slot.get((id(node), i))
+                if tt is not None and node._buf[i] is not None:
+                    captured[id(tt)] = node._buf[i]
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"grad node {node.name} was already released; call "
+                "backward(retain_graph=True) to backprop twice")
+        in_grads = node.vjp_fn(node._cotangents())
+        node._buf = None
+        if not retain_graph:
+            node.vjp_fn = None
+        for e, g in zip(node.edges, in_grads):
+            if e is None or _is_float0(g):
+                continue
+            if e.node is not None:
+                e.node._accumulate(e.out_idx, g)
+                e.node._deps -= 1
+                if e.node._deps == 0:
+                    queue.append(e.node)
+            elif e.leaf is not None:
+                leaf = e.leaf
+                if not leaf.stop_gradient:
+                    _leaf_accumulate(leaf, g)
+        # parents that received no gradient contribution from this node still
+        # need their dep count reduced for float0/None edges
+        for e, g in zip(node.edges, in_grads):
+            if e is not None and e.node is not None and _is_float0(g):
+                e.node._deps -= 1
+                if e.node._deps == 0:
+                    queue.append(e.node)
+
+    if targets is not None:
+        return captured
+    return None

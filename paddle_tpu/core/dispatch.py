@@ -1,0 +1,155 @@
+"""Eager op dispatch.
+
+Reference call path being reproduced (SURVEY §3.1): the generated
+`<op>_ad_func` layer — AMP auto-cast (eager_gen.py:588) → kernel selection +
+launch (api_base.py:452) → GradNode creation + TensorWrapper capture
+(eager_gen.py:1127).
+
+TPU-native design: the "kernel" is a jnp/lax function; XLA's per-primitive
+dispatch cache plays the role of the KernelFactory (phi/core/kernel_factory.h).
+When any input requires grad, the forward runs under jax.vjp and the returned
+closure *is* the GradNode's backward (residuals = TensorWrapper captures).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .tensor import Tensor
+from .flags import get_flag
+from paddle_tpu.autograd.tape import Edge, GradNode
+
+# --- global eager state (reference: egr::Controller / imperative::Tracer) ---
+_grad_enabled = True
+# AMP hook installed by paddle_tpu.amp: fn(op_name, arrays) -> arrays
+_amp_hook: Optional[Callable] = None
+# per-op observer hooks (profiler / nan check attach here)
+_op_observers = []
+
+
+def grad_enabled() -> bool:
+    return _grad_enabled
+
+
+def set_grad_enabled(flag: bool) -> bool:
+    global _grad_enabled
+    prev = _grad_enabled
+    _grad_enabled = bool(flag)
+    return prev
+
+
+def set_amp_hook(hook):
+    global _amp_hook
+    _amp_hook = hook
+
+
+def add_op_observer(cb):
+    _op_observers.append(cb)
+    return lambda: _op_observers.remove(cb)
+
+
+def _check_nan_inf(name, arrays):
+    level = get_flag("FLAGS_check_nan_inf_level")
+    for a in arrays:
+        if not jnp.issubdtype(a.dtype, jnp.inexact):
+            continue
+        try:
+            bad = bool(~jnp.isfinite(a).all())
+        except Exception:
+            return  # tracer — checked at runtime only in eager mode
+        if bad:
+            msg = f"NaN/Inf detected in output of op '{name}'"
+            if level >= 3:
+                print("[check_nan_inf]", msg)
+            else:
+                raise FloatingPointError(msg)
+
+
+def _differentiable(t: Tensor) -> bool:
+    return (not t.stop_gradient) and jnp.issubdtype(t._data.dtype, jnp.inexact)
+
+
+def run_op(name: str, fn: Callable, *inputs, n_outputs=None, amp=True,
+           out_stop_gradient=None, differentiable=True):
+    """Execute one eager op.
+
+    fn takes raw jax arrays (same arity as `inputs`) and returns an array or
+    a tuple of arrays. Tensor inputs are unwrapped; non-Tensor inputs are
+    converted with jnp.asarray.
+    """
+    arrays = []
+    in_tensors = []
+    for x in inputs:
+        if isinstance(x, Tensor):
+            arrays.append(x._data)
+            in_tensors.append(x)
+        else:
+            arrays.append(x if isinstance(x, jax.Array) else jnp.asarray(x))
+            in_tensors.append(None)
+
+    if amp and _amp_hook is not None:
+        arrays = _amp_hook(name, arrays)
+
+    needs = [t is not None and _differentiable(t) for t in in_tensors]
+    record = differentiable and _grad_enabled and any(needs)
+
+    if record:
+        out_arrays, vjp_fn = jax.vjp(fn, *arrays)
+    else:
+        out_arrays = fn(*arrays)
+
+    single = not isinstance(out_arrays, (tuple, list))
+    outs = (out_arrays,) if single else tuple(out_arrays)
+
+    if get_flag("FLAGS_check_nan_inf"):
+        _check_nan_inf(name, outs)
+    for cb in _op_observers:
+        cb(name, outs)
+
+    sg = not record if out_stop_gradient is None else out_stop_gradient
+    out_tensors = [Tensor._wrap(a, stop_gradient=sg) for a in outs]
+
+    if record:
+        edges = []
+        for t, need in zip(in_tensors, needs):
+            if not need:
+                edges.append(None)
+            elif t._grad_node is not None:
+                edges.append(Edge(node=t._grad_node, out_idx=t._out_idx))
+            else:
+                edges.append(Edge(leaf=t))
+        avals = [(tuple(a.shape), a.dtype) for a in outs]
+        if single:
+            # jax.vjp's closure wants the cotangent in the same structure
+            # as f's output (bare array, not 1-tuple)
+            inner_vjp = vjp_fn
+            vjp_fn = lambda cts: inner_vjp(cts[0])  # noqa: E731
+        node = GradNode(name, vjp_fn, edges, avals)
+        import weakref
+        for i, ot in enumerate(out_tensors):
+            if not ot.stop_gradient:
+                ot._grad_node = node
+                ot._out_idx = i
+                node.out_refs[i] = weakref.ref(ot)
+
+    return out_tensors[0] if single else tuple(out_tensors)
+
+
+def run_op_inplace(name: str, fn: Callable, target: Tensor, *extra_inputs,
+                   **kw):
+    """Inplace op: computes fn(target, *extra) then rebinds target's buffer
+    (ops.yaml `inplace:` semantics on immutable XLA buffers)."""
+    out = run_op(name, fn, target, *extra_inputs, **kw)
+    res = out[0] if isinstance(out, tuple) else out
+    target._assign_array(res._data)
+    # the result of an inplace op participates in autograd via the new node
+    target._grad_node = res._grad_node
+    target._out_idx = res._out_idx
+    target.stop_gradient = res.stop_gradient and target.stop_gradient
+    if res._grad_node is not None:
+        import weakref
+        res._grad_node.out_refs[res._out_idx] = weakref.ref(target)
+    return target

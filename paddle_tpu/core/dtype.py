@@ -1,0 +1,108 @@
+"""Dtype system.
+
+TPU-native replacement for the reference dtype library
+(paddle/phi/common/{data_type.h,bfloat16.h,float16.h,type_promotion.h}).
+Instead of hand-rolled device-portable scalar types, dtypes are numpy/ml_dtypes
+dtype objects (XLA understands these natively); promotion delegates to JAX's
+promotion lattice which matches the reference promoteTypes table
+(phi/common/type_promotion.h:53) for the types both support.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+# Canonical dtype objects (usable anywhere a dtype is accepted).
+bool_ = np.dtype(np.bool_)
+uint8 = np.dtype(np.uint8)
+int8 = np.dtype(np.int8)
+int16 = np.dtype(np.int16)
+int32 = np.dtype(np.int32)
+int64 = np.dtype(np.int64)
+float16 = np.dtype(np.float16)
+bfloat16 = np.dtype(ml_dtypes.bfloat16)
+float32 = np.dtype(np.float32)
+float64 = np.dtype(np.float64)
+complex64 = np.dtype(np.complex64)
+complex128 = np.dtype(np.complex128)
+float8_e4m3fn = np.dtype(ml_dtypes.float8_e4m3fn)
+float8_e5m2 = np.dtype(ml_dtypes.float8_e5m2)
+
+_ALIASES = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "fp16": float16,
+    "float32": float32,
+    "fp32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+}
+
+_DEFAULT_DTYPE = float32
+
+
+def convert_dtype(dtype):
+    """Normalize any dtype spec (str / np.dtype / type) to a np.dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, np.dtype):
+        return dtype
+    if isinstance(dtype, str):
+        key = dtype.lower().replace("paddle.", "")
+        if key in _ALIASES:
+            return _ALIASES[key]
+    return np.dtype(dtype)
+
+
+def set_default_dtype(dtype):
+    global _DEFAULT_DTYPE
+    d = convert_dtype(dtype)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(
+            "set_default_dtype only supports float16/bfloat16/float32/float64, "
+            f"got {d}"
+        )
+    _DEFAULT_DTYPE = d
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE
+
+
+def is_floating_point(dtype):
+    d = convert_dtype(dtype)
+    return d in (float16, bfloat16, float32, float64, float8_e4m3fn, float8_e5m2)
+
+
+def is_integer(dtype):
+    d = convert_dtype(dtype)
+    return np.issubdtype(d, np.integer) or d == bool_
+
+
+def is_complex(dtype):
+    d = convert_dtype(dtype)
+    return np.issubdtype(d, np.complexfloating)
+
+
+def promote_types(a, b):
+    """Binary dtype promotion (reference: phi/common/type_promotion.h:53)."""
+    return np.dtype(jnp.promote_types(convert_dtype(a), convert_dtype(b)))
+
+
+def finfo(dtype):
+    return ml_dtypes.finfo(convert_dtype(dtype))
+
+
+def iinfo(dtype):
+    return np.iinfo(convert_dtype(dtype))

@@ -1,0 +1,81 @@
+"""Runtime flag registry (reference: paddle/common/flags.cc, 178 flags;
+PD_DEFINE_* macros flags.h:38; exported to Python as paddle.set_flags /
+FLAGS_* env vars).
+
+TPU-native version: a typed Python registry with env-var override at
+definition time. Native-side knobs map onto XLA_FLAGS, which XLA itself owns.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    value: Any
+    help: str
+    type: type
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+_OBSERVERS: Dict[str, Callable[[Any], None]] = {}
+
+
+def _coerce(ty, raw):
+    if ty is bool:
+        if isinstance(raw, str):
+            return raw.lower() in ("1", "true", "yes", "on")
+        return bool(raw)
+    return ty(raw)
+
+
+def define_flag(name: str, default, help: str = ""):
+    ty = type(default)
+    raw = os.environ.get(name, None)
+    value = _coerce(ty, raw) if raw is not None else default
+    _REGISTRY[name] = _Flag(name, default, value, help, ty)
+    return value
+
+
+def get_flags(names=None):
+    if names is None:
+        names = list(_REGISTRY)
+    if isinstance(names, str):
+        names = [names]
+    return {n: _REGISTRY[n].value for n in names}
+
+
+def get_flag(name: str):
+    return _REGISTRY[name].value
+
+
+def set_flags(flags: Dict[str, Any]):
+    for name, v in flags.items():
+        if name not in _REGISTRY:
+            raise KeyError(f"unknown flag {name!r}")
+        f = _REGISTRY[name]
+        f.value = _coerce(f.type, v)
+        cb = _OBSERVERS.get(name)
+        if cb is not None:
+            cb(f.value)
+
+
+def on_flag_change(name: str, cb: Callable[[Any], None]):
+    _OBSERVERS[name] = cb
+
+
+# Core flags (subset of paddle/common/flags.cc the TPU build honors).
+define_flag("FLAGS_check_nan_inf", False,
+            "scan every op output for NaN/Inf (flags.cc:72 equivalent)")
+define_flag("FLAGS_check_nan_inf_level", 0,
+            "0: raise on nan/inf; 3: log only")
+define_flag("FLAGS_benchmark", False, "block on every op for timing")
+define_flag("FLAGS_log_level", 0, "framework verbosity")
+define_flag("FLAGS_eager_op_cache", True,
+            "cache per-op compiled executables in eager mode")
+define_flag("FLAGS_collective_timeout_s", 600.0,
+            "collective watchdog timeout seconds")

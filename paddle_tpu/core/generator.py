@@ -1,0 +1,66 @@
+"""RNG state (reference: phi/core/generator.h — per-device generator with
+seed + offset-based philox).
+
+TPU-native: JAX threefry keys. The reference's (seed, offset) pair maps to
+(seed key, fold_in counter): every random op consumes `fold_in(key, offset++)`,
+which is the same splittable-counter discipline phi uses for philox offsets and
+is safe under jit (the counter is read at trace time; traced programs get a key
+argument instead — see paddle_tpu.jit).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+
+class Generator:
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        with getattr(self, "_lock", threading.Lock()):
+            self._seed = int(seed)
+            self._key = jax.random.PRNGKey(self._seed)
+            self._offset = 0
+        return self
+
+    def seed(self):
+        return self._seed
+
+    def get_state(self):
+        return {"seed": self._seed, "offset": self._offset}
+
+    def set_state(self, state):
+        self._seed = int(state["seed"])
+        self._key = jax.random.PRNGKey(self._seed)
+        self._offset = int(state["offset"])
+
+    def next_key(self):
+        """One fresh PRNG key; bumps the offset (philox-offset equivalent)."""
+        with self._lock:
+            off = self._offset
+            self._offset += 1
+        return jax.random.fold_in(self._key, off)
+
+    def initial_seed(self):
+        return self._seed
+
+
+_DEFAULT = Generator(seed=np.random.randint(0, 2**31 - 1))
+
+
+def default_generator() -> Generator:
+    return _DEFAULT
+
+
+def seed(s: int) -> Generator:
+    """paddle.seed equivalent: reseed the default generator."""
+    _DEFAULT.manual_seed(s)
+    return _DEFAULT
+
+
+def next_key():
+    return _DEFAULT.next_key()

@@ -1,0 +1,128 @@
+"""Device identity (reference: Place, phi/common/place.h:58).
+
+A Place names a device; on TPU it resolves to a concrete jax.Device. The
+reference's AllocationType enum (place.h:31) collapses to the JAX platform
+string ('tpu' / 'cpu' / 'gpu'), and CustomRegisteredDeviceMap (place.h:41)
+collapses to JAX's pluggable-backend registry.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+class Place:
+    """Base device identity: (device_type, device_id)."""
+
+    device_type = "undefined"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    # -- resolution to a concrete jax device -------------------------------
+    def get_device(self) -> jax.Device:
+        devs = _devices_of(self.device_type)
+        if not devs:
+            raise RuntimeError(
+                f"no {self.device_type!r} devices visible to JAX "
+                f"(available: {[d.platform for d in jax.devices()]})"
+            )
+        return devs[self.device_id % len(devs)]
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+
+class CPUPlace(Place):
+    device_type = "cpu"
+
+    def __repr__(self):
+        return "Place(cpu)"
+
+
+class TPUPlace(Place):
+    device_type = "tpu"
+
+
+class CUDAPlace(Place):  # accepted for API compatibility; maps to 'gpu'
+    device_type = "gpu"
+
+
+class CustomPlace(Place):
+    def __init__(self, device_type: str, device_id: int = 0):
+        super().__init__(device_id)
+        self.device_type = device_type
+
+
+@functools.lru_cache(maxsize=None)
+def _devices_of(platform: str):
+    try:
+        return tuple(jax.devices(platform))
+    except RuntimeError:
+        return ()
+
+
+def _default_platform() -> str:
+    return jax.devices()[0].platform
+
+
+_CURRENT_PLACE = None
+
+
+def set_device(device) -> Place:
+    """paddle.set_device equivalent: 'tpu', 'tpu:1', 'cpu', 'gpu:0'."""
+    global _CURRENT_PLACE
+    _CURRENT_PLACE = _parse_place(device)
+    return _CURRENT_PLACE
+
+
+def get_device() -> str:
+    p = _current_place()
+    return f"{p.device_type}:{p.device_id}"
+
+
+def _parse_place(device) -> Place:
+    if isinstance(device, Place):
+        return device
+    if isinstance(device, jax.Device):
+        return _place_for(device.platform, device.id)
+    if isinstance(device, str):
+        name, _, idx = device.partition(":")
+        return _place_for(name.lower(), int(idx) if idx else 0)
+    raise ValueError(f"cannot interpret device spec {device!r}")
+
+
+def _place_for(platform: str, idx: int) -> Place:
+    if platform == "cpu":
+        return CPUPlace(idx)
+    if platform == "tpu":
+        return TPUPlace(idx)
+    if platform in ("gpu", "cuda"):
+        return CUDAPlace(idx)
+    return CustomPlace(platform, idx)
+
+
+def _current_place() -> Place:
+    global _CURRENT_PLACE
+    if _CURRENT_PLACE is None:
+        _CURRENT_PLACE = _place_for(_default_platform(), 0)
+    return _CURRENT_PLACE
+
+
+def default_device() -> jax.Device:
+    return _current_place().get_device()
+
+
+def is_compiled_with_tpu() -> bool:
+    return bool(_devices_of("tpu"))

@@ -1,0 +1,325 @@
+"""Eager Tensor.
+
+Reference being reproduced: the public ref-counted Tensor handle
+(phi/api/include/tensor.h:82) + AutogradMeta (eager/autograd_meta.h:61) +
+DenseTensor meta (phi/core/dense_tensor.h:37).
+
+TPU-native design: the storage is a jax.Array (an XLA on-device buffer —
+the DenseTensor/Allocation pair collapses into it); autograd metadata lives
+directly on the Python handle. Mutation (`inplace:` ops in ops.yaml) is
+rebinding `_data` with a version bump — XLA buffers are immutable, so saved
+backward residuals can never be corrupted by inplace ops (the reference needs
+version counters to *detect* this; we keep the counter for API parity).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtype as dtype_mod
+from .place import Place, _current_place, _parse_place
+
+
+class Tensor:
+    __slots__ = ("_data", "stop_gradient", "grad", "_grad_node", "_out_idx",
+                 "name", "persistable", "_grad_hooks", "_post_acc_hooks",
+                 "_version", "_sharding_hint", "__weakref__", "__dict__")
+
+    # make Tensor win over np.ndarray in mixed dunder dispatch
+    __array_priority__ = 100
+
+    def __init__(self, data=None, dtype=None, place=None, stop_gradient=True,
+                 name=None):
+        dt = dtype_mod.convert_dtype(dtype)
+        if isinstance(data, Tensor):
+            arr = data._data
+            if dt is not None and arr.dtype != dt:
+                arr = arr.astype(dt)
+        elif data is None:
+            arr = jnp.zeros((), dt or dtype_mod.get_default_dtype())
+        else:
+            if isinstance(data, (float, int, bool, complex)) or (
+                    isinstance(data, (list, tuple))):
+                data = np.asarray(data)
+            if isinstance(data, np.ndarray) and dt is None and \
+                    data.dtype == np.float64:
+                # match paddle.to_tensor: python floats land as default dtype
+                dt = dtype_mod.get_default_dtype()
+            arr = jnp.asarray(data, dtype=dt)
+        if place is not None:
+            arr = jax.device_put(arr, _parse_place(place).get_device())
+        self._init_from_array(arr, stop_gradient, name)
+
+    def _init_from_array(self, arr, stop_gradient=True, name=None):
+        self._data = arr
+        self.stop_gradient = bool(stop_gradient)
+        self.grad: Optional[Tensor] = None
+        self._grad_node = None
+        self._out_idx = 0
+        self.name = name
+        self.persistable = False
+        self._grad_hooks = []
+        self._post_acc_hooks = []
+        self._version = 0
+        self._sharding_hint = None
+
+    @classmethod
+    def _wrap(cls, arr, stop_gradient=True) -> "Tensor":
+        t = cls.__new__(cls)
+        t._init_from_array(arr, stop_gradient)
+        return t
+
+    # ------------------------------------------------------------------ meta
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    dim = property(lambda self: self._data.ndim)
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    @property
+    def place(self) -> Place:
+        try:
+            dev = next(iter(self._data.devices()))
+            return _parse_place(dev)
+        except Exception:  # tracer inside jit
+            return _current_place()
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    @property
+    def T(self):
+        from paddle_tpu import ops
+        return ops.manipulation.transpose(
+            self, list(range(self.ndim))[::-1])
+
+    @property
+    def mT(self):
+        from paddle_tpu import ops
+        perm = list(range(self.ndim))
+        perm[-2], perm[-1] = perm[-1], perm[-2]
+        return ops.manipulation.transpose(self, perm)
+
+    @property
+    def data(self):
+        return self
+
+    @data.setter
+    def data(self, value):
+        v = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+        self._assign_array(v)
+
+    def inplace_version(self):
+        return self._version
+
+    # ------------------------------------------------------------- transfer
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self, *args):
+        arr = np.asarray(self._data)
+        return arr.item(*args)
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def detach(self) -> "Tensor":
+        return Tensor._wrap(self._data, stop_gradient=True)
+
+    def detach_(self) -> "Tensor":
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from paddle_tpu.core.dispatch import run_op
+        return run_op("clone", lambda x: x + jnp.zeros((), x.dtype), self)
+
+    def to(self, *args, **kwargs):
+        """to(dtype) / to(device) / to(device, dtype)."""
+        device = kwargs.get("device")
+        dt = kwargs.get("dtype")
+        for a in args:
+            if isinstance(a, (str, Place, jax.Device)):
+                try:
+                    dt2 = dtype_mod.convert_dtype(a)
+                    dt = dt2
+                    continue
+                except TypeError:
+                    pass
+                device = a
+            else:
+                dt = a
+        arr = self._data
+        if dt is not None:
+            arr = arr.astype(dtype_mod.convert_dtype(dt))
+        if device is not None:
+            arr = jax.device_put(arr, _parse_place(device).get_device())
+        out = Tensor._wrap(arr, self.stop_gradient)
+        return out
+
+    def cpu(self):
+        return self.to(device="cpu")
+
+    def pin_memory(self):
+        return self
+
+    def cuda(self, device_id=0):
+        return self.to(device=f"gpu:{device_id}")
+
+    # ------------------------------------------------------------- autograd
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from paddle_tpu.autograd.tape import run_backward
+        run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def register_hook(self, hook):
+        self._grad_hooks.append(hook)
+
+        class _Handle:
+            def remove(_self):
+                try:
+                    self._grad_hooks.remove(hook)
+                except ValueError:
+                    pass
+        return _Handle()
+
+    def _register_grad_hook(self, hook):
+        return self.register_hook(hook)
+
+    def _register_backward_hook(self, hook):
+        """Post-accumulation hook on a leaf (reference: accumulation node
+        hooks — where the DP reducer attaches, reducer.cc:794)."""
+        self._post_acc_hooks.append(hook)
+
+    def clear_grad(self, set_to_zero=False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor._wrap(jnp.zeros_like(self.grad._data), True)
+        else:
+            self.grad = None
+
+    def clear_gradient(self, set_to_zero=False):
+        self.clear_grad(set_to_zero)
+
+    def zero_grad(self):
+        self.clear_grad()
+
+    @property
+    def grad_fn(self):
+        return self._grad_node
+
+    # ------------------------------------------------------------ mutation
+    def _assign_array(self, arr):
+        """Inplace rebind (the `inplace: (x -> out)` discipline, ops.yaml:16)."""
+        self._data = arr
+        self._version += 1
+        return self
+
+    def set_value(self, value):
+        v = value._data if isinstance(value, Tensor) else \
+            jnp.asarray(value, dtype=self._data.dtype)
+        if tuple(v.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {v.shape} vs {self._data.shape}")
+        return self._assign_array(v.astype(self._data.dtype))
+
+    def copy_(self, other):
+        return self.set_value(other)
+
+    # ------------------------------------------------------------- dunders
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        try:
+            body = np.array2string(np.asarray(self._data), precision=8,
+                                   separator=", ")
+        except Exception:
+            body = f"<traced {self._data}>"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+                f"place={getattr(self.place, 'device_type', '?')}, "
+                f"stop_gradient={sg},\n       {body})")
+
+    def __bool__(self):
+        return bool(np.asarray(self._data))
+
+    def __int__(self):
+        return int(np.asarray(self._data))
+
+    def __float__(self):
+        return float(np.asarray(self._data))
+
+    def __index__(self):
+        return int(np.asarray(self._data))
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return str(self)
+
+    def __hash__(self):
+        return id(self)
+
+    def __dlpack__(self, *a, **k):
+        return self._data.__dlpack__(*a, **k)
+
+    # arithmetic / comparison / indexing dunders are patched in by
+    # paddle_tpu.ops (see ops/__init__.py: _patch_tensor_methods) so the op
+    # layer stays in one place (mirrors paddle's math-op patch,
+    # fluid/pybind/eager_math_op_patch.cc).
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: base.framework.Parameter / EagerParamBase)."""
+
+    def __init__(self, data=None, dtype=None, stop_gradient=False,
+                 trainable=True, name=None, **kw):
+        super().__init__(data, dtype=dtype, stop_gradient=stop_gradient,
+                         name=name)
+        self.trainable = trainable
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_distributed = False
+
+    @classmethod
+    def _wrap_param(cls, arr, trainable=True, name=None):
+        p = cls.__new__(cls)
+        p._init_from_array(arr, stop_gradient=not trainable, name=name)
+        p.trainable = trainable
+        p.persistable = True
+        p.optimize_attr = {"learning_rate": 1.0}
+        p.regularizer = None
+        p.need_clip = True
+        p.is_distributed = False
+        return p
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()

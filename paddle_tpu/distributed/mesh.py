@@ -1,0 +1,237 @@
+"""Device mesh + placements.
+
+Reference: ProcessMesh (python/paddle/distributed/auto_parallel/
+process_mesh.py:85), placements Shard/Replicate/Partial
+(phi/core/distributed/auto_parallel/dist_tensor.h + placement_types), and
+the hybrid topology axis order pp→mp(tp)→sep→sharding→dp
+(fleet/base/topology.py:70).
+
+TPU-native: ProcessMesh IS a jax.sharding.Mesh; placements map to
+PartitionSpec dims. XLA/GSPMD then plays the role of the reference's
+reshard lattice + per-op SPMD rules (phi/infermeta/spmd_rules).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+# ---------------------------- placements -----------------------------------
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("S", self.dim))
+
+
+class Replicate(Placement):
+    def is_replicate(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("R")
+
+
+class Partial(Placement):
+    """Pending-reduction placement. GSPMD has no first-class partial for
+    inputs; reshard() materializes it via psum when converting to
+    Replicate/Shard (the reference's P→R / P→S reshard functions)."""
+
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and \
+            other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("P", self.reduce_type))
+
+
+class ReduceType:
+    kRedSum = "sum"
+    kRedMax = "max"
+    kRedMin = "min"
+    kRedProd = "prod"
+    kRedAvg = "avg"
+
+
+# ------------------------------- mesh --------------------------------------
+_GLOBAL_MESH: Optional["ProcessMesh"] = None
+
+
+class ProcessMesh:
+    """N-d logical device mesh (reference process_mesh.py:85)."""
+
+    def __init__(self, mesh=None, dim_names: Optional[Sequence[str]] = None,
+                 shape: Optional[Sequence[int]] = None,
+                 process_ids: Optional[Sequence[int]] = None,
+                 devices=None):
+        if mesh is not None and isinstance(mesh, Mesh):
+            self._jax_mesh = mesh
+            self._dim_names = list(mesh.axis_names)
+            self._shape = list(np.array(mesh.devices).shape)
+            return
+        if shape is None:
+            arr = np.asarray(mesh)
+            shape = list(arr.shape)
+        else:
+            shape = list(shape)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(len(shape))]
+        n = int(np.prod(shape))
+        devs = list(devices) if devices is not None else jax.devices()[:n]
+        if len(devs) < n:
+            raise ValueError(
+                f"mesh shape {shape} needs {n} devices, only "
+                f"{len(devs)} available")
+        self._jax_mesh = Mesh(
+            np.asarray(devs[:n]).reshape(shape), tuple(dim_names))
+        self._dim_names = list(dim_names)
+        self._shape = shape
+
+    # reference-compatible surface
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return [d.id for d in self._jax_mesh.devices.flat]
+
+    @property
+    def mesh(self):
+        return np.asarray(
+            [d.id for d in self._jax_mesh.devices.flat]).reshape(self._shape)
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    def get_dim_size(self, name):
+        return self._shape[self._dim_names.index(name)]
+
+    def get_mesh_with_dim(self, dim_name, index=None):
+        """Sub-mesh along one axis (reference get_mesh_with_dim)."""
+        axis = self._dim_names.index(dim_name)
+        devs = np.moveaxis(np.asarray(self._jax_mesh.devices), axis, 0)
+        if index is not None:
+            sub = devs[index]
+            names = [n for n in self._dim_names if n != dim_name]
+            return ProcessMesh(mesh=Mesh(sub, tuple(names)))
+        names = [dim_name] + [n for n in self._dim_names if n != dim_name]
+        return ProcessMesh(mesh=Mesh(devs, tuple(names)))
+
+    def __eq__(self, other):
+        return isinstance(other, ProcessMesh) and \
+            self._shape == other._shape and \
+            self._dim_names == other._dim_names
+
+    def __enter__(self):
+        global _GLOBAL_MESH
+        self._prev = _GLOBAL_MESH
+        _GLOBAL_MESH = self
+        return self
+
+    def __exit__(self, *exc):
+        global _GLOBAL_MESH
+        _GLOBAL_MESH = self._prev
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self._shape}, " \
+               f"dim_names={self._dim_names})"
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _GLOBAL_MESH
+
+
+def set_mesh(mesh: ProcessMesh):
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+    return mesh
+
+
+def auto_mesh(**axis_sizes) -> ProcessMesh:
+    """Build a mesh over all local devices, e.g. auto_mesh(dp=2, tp=4)."""
+    names = list(axis_sizes)
+    shape = [axis_sizes[n] for n in names]
+    return ProcessMesh(shape=shape, dim_names=names)
+
+
+def placements_to_spec(placements: Sequence[Placement],
+                       mesh: ProcessMesh, ndim: int) -> PartitionSpec:
+    """[Shard(0), Replicate()] over mesh axes -> PartitionSpec per tensor
+    dim. placement[i] describes mesh axis i (reference convention)."""
+    entries: List[Optional[List[str]]] = [None] * ndim
+    for axis_idx, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            d = pl.dim
+            name = mesh.dim_names[axis_idx]
+            if entries[d] is None:
+                entries[d] = [name]
+            else:
+                entries[d].append(name)
+    spec = [tuple(e) if e and len(e) > 1 else (e[0] if e else None)
+            for e in entries]
+    return PartitionSpec(*spec)
+
+
+def spec_to_placements(spec: PartitionSpec, mesh: ProcessMesh,
+                       ndim: int) -> List[Placement]:
+    placements: List[Placement] = [Replicate()
+                                   for _ in range(len(mesh.dim_names))]
+    for d, entry in enumerate(tuple(spec) + (None,) * (ndim - len(spec))):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        for name in names:
+            placements[mesh.dim_names.index(name)] = Shard(d)
+    return placements

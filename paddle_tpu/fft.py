@@ -1,0 +1,59 @@
+"""paddle.fft equivalent over jnp.fft (reference: python/paddle/fft.py over
+pocketfft/cuFFT kernels — XLA lowers FFT natively)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import run_op
+
+
+def _fft_op(name, jfn):
+    def op(x, n=None, axis=-1, norm="backward", name_arg=None):
+        return run_op(name, lambda a: jfn(a, n=n, axis=axis, norm=norm), x)
+    op.__name__ = name
+    return op
+
+
+def _fftn_op(name, jfn):
+    def op(x, s=None, axes=None, norm="backward", name_arg=None):
+        return run_op(name, lambda a: jfn(a, s=s, axes=axes, norm=norm), x)
+    op.__name__ = name
+    return op
+
+
+fft = _fft_op("fft", jnp.fft.fft)
+ifft = _fft_op("ifft", jnp.fft.ifft)
+rfft = _fft_op("rfft", jnp.fft.rfft)
+irfft = _fft_op("irfft", jnp.fft.irfft)
+hfft = _fft_op("hfft", jnp.fft.hfft)
+ihfft = _fft_op("ihfft", jnp.fft.ihfft)
+fft2 = _fftn_op("fft2", lambda a, s, axes, norm: jnp.fft.fft2(
+    a, s=s, axes=axes or (-2, -1), norm=norm))
+ifft2 = _fftn_op("ifft2", lambda a, s, axes, norm: jnp.fft.ifft2(
+    a, s=s, axes=axes or (-2, -1), norm=norm))
+rfft2 = _fftn_op("rfft2", lambda a, s, axes, norm: jnp.fft.rfft2(
+    a, s=s, axes=axes or (-2, -1), norm=norm))
+irfft2 = _fftn_op("irfft2", lambda a, s, axes, norm: jnp.fft.irfft2(
+    a, s=s, axes=axes or (-2, -1), norm=norm))
+fftn = _fftn_op("fftn", jnp.fft.fftn)
+ifftn = _fftn_op("ifftn", jnp.fft.ifftn)
+rfftn = _fftn_op("rfftn", jnp.fft.rfftn)
+irfftn = _fftn_op("irfftn", jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from paddle_tpu.core.tensor import Tensor
+    return Tensor._wrap(jnp.fft.fftfreq(n, d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from paddle_tpu.core.tensor import Tensor
+    return Tensor._wrap(jnp.fft.rfftfreq(n, d))
+
+
+def fftshift(x, axes=None, name=None):
+    return run_op("fftshift", lambda a: jnp.fft.fftshift(a, axes=axes), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    return run_op("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=axes), x)

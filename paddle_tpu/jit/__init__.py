@@ -1,0 +1,280 @@
+"""paddle.jit equivalent — dygraph-to-static.
+
+Reference: paddle.jit.to_static (jit/api.py:195) traces Python into a PIR
+program executed by the StandaloneExecutor (SURVEY §3.6/§3.4). TPU-native
+design: the traced program IS an XLA executable. Because every eager op in
+this framework is a traceable jnp computation (including the tape autograd
+and optimizer updates, which mutate Tensor._data), a whole train step —
+forward, loss.backward(), optimizer.step() — traces into ONE compiled XLA
+program via functional state threading:
+
+    state_in (params, buffers, opt slots, RNG key) ──┐
+    args (batch) ────────────────────────────────────┤ jit(pure) ── outputs
+    state_out  ◄─────────────────────────────────────┘    (donated buffers)
+
+Mutated Tensor buffers are discovered by re-reading `_data` after the traced
+call; the RNG key is threaded so dropout differs per step. This replaces the
+reference's PirInterpreter + stream analyzer + CINN with XLA end to end.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Iterable, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import generator as gen_mod
+from paddle_tpu.core.tensor import Tensor
+
+
+def _collect_objects(args):
+    """Find Layers/Optimizers/GradScalers among positional objects."""
+    from paddle_tpu.nn.layer.layers import Layer
+    from paddle_tpu.optimizer.optimizer import Optimizer
+    layers, opts, scalers = [], [], []
+    for a in args or ():
+        if isinstance(a, Layer):
+            layers.append(a)
+        elif isinstance(a, Optimizer):
+            opts.append(a)
+        elif hasattr(a, "_scale") and hasattr(a, "step"):
+            scalers.append(a)
+    return layers, opts, scalers
+
+
+def _state_tensors(layers, opts, scalers) -> List[Tensor]:
+    seen = set()
+    out = []
+    def add(t):
+        if t is not None and id(t) not in seen:
+            seen.add(id(t))
+            out.append(t)
+    for l in layers:
+        for _, p in l.named_parameters():
+            add(p)
+        for _, b in l.named_buffers():
+            add(b)
+    for o in opts:
+        o._create_accumulators()
+        for t in o._state_tensors():
+            add(t)
+    for s in scalers:
+        add(s._scale)
+    return out
+
+
+def _tree_flatten_args(args, kwargs):
+    """Flatten (args, kwargs) into (arrays, treedef-with-static-leaves)."""
+    leaves, treedef = jax.tree_util.tree_flatten(
+        (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+    arrays = []
+    spec = []  # ("T", stop_gradient) | ("S", value)
+    for leaf in leaves:
+        if isinstance(leaf, Tensor):
+            arrays.append(leaf._data)
+            spec.append(("T", leaf.stop_gradient))
+        else:
+            spec.append(("S", leaf))
+    return arrays, (treedef, tuple(
+        s if s[0] == "S" else ("T", s[1]) for s in spec))
+
+
+def _tree_unflatten_args(arrays, meta):
+    treedef, spec = meta
+    arrays = list(arrays)
+    leaves = []
+    for s in spec:
+        if s[0] == "T":
+            t = Tensor._wrap(arrays.pop(0), stop_gradient=s[1])
+            leaves.append(t)
+        else:
+            leaves.append(s[1])
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _flatten_out(out):
+    leaves, treedef = jax.tree_util.tree_flatten(
+        out, is_leaf=lambda x: isinstance(x, Tensor))
+    arrays = []
+    spec = []
+    for leaf in leaves:
+        if isinstance(leaf, Tensor):
+            arrays.append(leaf._data)
+            spec.append("T")
+        else:
+            spec.append(("S", leaf))
+    return arrays, (treedef, spec)
+
+
+class StaticFunction:
+    def __init__(self, fn, objs=None, donate_states=True, backend=None):
+        self._fn = fn
+        self._objs = objs
+        self._donate = donate_states
+        self._cache = {}
+        self._state: Optional[List[Tensor]] = None
+        functools.update_wrapper(self, fn, updated=[])
+
+    def _resolve_state(self):
+        objs = self._objs
+        if objs is None:
+            # bound Layer method: use the owning layer
+            owner = getattr(self._fn, "__self__", None)
+            objs = [owner] if owner is not None else []
+        layers, opts, scalers = _collect_objects(objs)
+        return _state_tensors(layers, opts, scalers)
+
+    def __call__(self, *args, **kwargs):
+        state = self._resolve_state()
+        gen = gen_mod.default_generator()
+        arg_arrays, meta = _tree_flatten_args(args, kwargs)
+        key = (meta[0], tuple(
+            s if s[0] == "S" and _hashable(s) else ("T",)
+            for s in meta[1]), len(state))
+
+        if key not in self._cache:
+            self._cache[key] = [self._build(state, meta), None]
+        jitted, out_spec = self._cache[key]
+
+        state_arrays = [t._data for t in state]
+        key_in = gen._key
+        out_arrays, new_state, new_key = jitted(
+            state_arrays, key_in, arg_arrays)
+        for t, a in zip(state, new_state):
+            t._data = a
+        gen._key = new_key
+        if out_spec is None:
+            out_spec = self._out_spec  # set by pure() during the trace
+            self._cache[key][1] = out_spec
+        return _unflatten_out(out_arrays, out_spec)
+
+    def _build(self, state_template, meta):
+        fn = self._fn
+        outer = self
+
+        def pure(state_arrays, rng_key, arg_arrays):
+            state = outer._resolve_state()
+            saved = [t._data for t in state]
+            saved_nodes = [(t._grad_node, t._out_idx, t.grad)
+                           for t in state]
+            gen = gen_mod.default_generator()
+            saved_key, saved_off = gen._key, gen._offset
+            try:
+                for t, a in zip(state, state_arrays):
+                    t._data = a
+                    t._grad_node = None
+                    t.grad = None
+                gen._key = rng_key
+                gen._offset = 0
+                args, kwargs = _tree_unflatten_args(arg_arrays, meta)
+                out = fn(*args, **kwargs)
+                out_arrays, out_spec = _flatten_out(out)
+                outer._out_spec = out_spec
+                new_state = [t._data for t in state]
+                new_key = jax.random.fold_in(rng_key, gen._offset + 1)
+                return out_arrays, new_state, new_key
+            finally:
+                for t, s, (n, i, g) in zip(state, saved, saved_nodes):
+                    t._data = s
+                    t._grad_node = n
+                    t._out_idx = i
+                    t.grad = g
+                gen._key, gen._offset = saved_key, saved_off
+
+        donate = (0,) if self._donate else ()
+        return jax.jit(pure, donate_argnums=donate)
+
+
+def _hashable(s):
+    try:
+        hash(s)
+        return True
+    except TypeError:
+        return False
+
+
+def _unflatten_out(arrays, spec):
+    treedef, kinds = spec
+    arrays = list(arrays)
+    leaves = []
+    for k in kinds:
+        if k == "T":
+            leaves.append(Tensor._wrap(arrays.pop(0), stop_gradient=True))
+        else:
+            leaves.append(k[1])
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True, objs=None, donate=True,
+              **kwargs):
+    """paddle.jit.to_static equivalent.
+
+    `objs`: the Layers / Optimizers / GradScalers whose device state the
+    compiled program threads through (auto-detected for bound Layer
+    methods). Compile a whole train step by passing [model, optimizer].
+    """
+    def decorate(fn):
+        from paddle_tpu.nn.layer.layers import Layer
+        if isinstance(fn, Layer):
+            sf = StaticFunction(fn.forward, objs=[fn] + list(objs or ()),
+                                donate_states=donate)
+            fn.forward = sf
+            return fn
+        return StaticFunction(fn, objs=objs, donate_states=donate)
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    return fn
+
+
+def ignore_module(modules):
+    pass
+
+
+def enable_to_static(flag):
+    pass
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save — persists state_dict + (optionally) the traced
+    StableHLO text for inspection/deployment."""
+    from paddle_tpu.framework.io import save as _save
+    from paddle_tpu.nn.layer.layers import Layer
+    if isinstance(layer, Layer):
+        _save(layer.state_dict(), path + ".pdiparams")
+        if input_spec:
+            try:
+                arrays = [s._data if isinstance(s, Tensor)
+                          else jnp.zeros(s.shape, s.dtype)
+                          for s in input_spec]
+                lowered = jax.jit(
+                    lambda *xs: layer(*[Tensor._wrap(x) for x in xs])._data
+                ).lower(*arrays)
+                with open(path + ".stablehlo.txt", "w") as f:
+                    f.write(lowered.as_text())
+            except Exception:
+                pass
+    else:
+        _save(layer, path + ".pdiparams")
+
+
+def load(path, **configs):
+    from paddle_tpu.framework.io import load as _load
+    return _load(path + ".pdiparams")
+
+
+class InputSpec:
+    """Static-shape declaration (reference paddle.static.InputSpec)."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        from paddle_tpu.core import dtype as dtype_mod
+        self.shape = tuple(-1 if s is None else int(s) for s in shape)
+        self.dtype = dtype_mod.convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient

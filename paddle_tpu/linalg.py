@@ -1,0 +1,9 @@
+"""paddle.linalg namespace (reference: python/paddle/linalg.py re-exports)."""
+from paddle_tpu.ops.linalg import (  # noqa: F401
+    cholesky, cholesky_solve, cholesky_inverse, cond, corrcoef, cov, det,
+    eig, eigh, eigvals, eigvalsh, householder_product, inv, lstsq, lu,
+    lu_unpack, matrix_norm, matrix_power, matrix_rank, multi_dot, norm,
+    ormqr, pca_lowrank, pinv, qr, slogdet, solve, svd, svd_lowrank,
+    triangular_solve, vector_norm,
+)
+from paddle_tpu.ops.linalg import matmul  # noqa: F401

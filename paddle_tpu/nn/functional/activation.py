@@ -1,0 +1,212 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py
+over phi activation kernels — all are single fused XLA elementwise graphs)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import run_op
+from paddle_tpu.core.tensor import Tensor
+
+
+def relu(x, name=None):
+    return run_op("relu", jax.nn.relu, x)
+
+
+def relu_(x, name=None):
+    from paddle_tpu.core.dispatch import run_op_inplace
+    return run_op_inplace("relu_", jax.nn.relu, x)
+
+
+def relu6(x, name=None):
+    return run_op("relu6", jax.nn.relu6, x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return run_op("leaky_relu",
+                  lambda a: jax.nn.leaky_relu(a, negative_slope), x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size == 1:
+            wb = w.reshape(())
+        else:
+            shape = [1] * a.ndim
+            ch = 1 if data_format[1] == "C" else a.ndim - 1
+            shape[ch] = w.size
+            wb = w.reshape(shape)
+        return jnp.where(a > 0, a, wb * a)
+    return run_op("prelu", f, x, weight)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    from paddle_tpu.core import generator as gen_mod
+    if training:
+        key = gen_mod.next_key()
+        def f(a):
+            slope = jax.random.uniform(key, a.shape, a.dtype, lower, upper)
+            return jnp.where(a >= 0, a, slope * a)
+        return run_op("rrelu", f, x)
+    mid = (lower + upper) / 2.0
+    return leaky_relu(x, mid)
+
+
+def elu(x, alpha=1.0, name=None):
+    return run_op("elu", lambda a: jax.nn.elu(a, alpha), x)
+
+
+def elu_(x, alpha=1.0, name=None):
+    from paddle_tpu.core.dispatch import run_op_inplace
+    return run_op_inplace("elu_", lambda a: jax.nn.elu(a, alpha), x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return run_op("selu",
+                  lambda a: scale * jnp.where(
+                      a > 0, a, alpha * (jnp.exp(a) - 1)), x)
+
+
+def celu(x, alpha=1.0, name=None):
+    return run_op("celu", lambda a: jax.nn.celu(a, alpha), x)
+
+
+def gelu(x, approximate=False, name=None):
+    return run_op("gelu", lambda a: jax.nn.gelu(a, approximate=approximate),
+                  x)
+
+
+def silu(x, name=None):
+    return run_op("silu", jax.nn.silu, x)
+
+
+def swish(x, name=None):
+    return run_op("swish", jax.nn.silu, x)
+
+
+def hardswish(x, name=None):
+    return run_op("hardswish",
+                  lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x)
+
+
+def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5, name=None):
+    return run_op("hardsigmoid",
+                  lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return run_op("hardtanh", lambda a: jnp.clip(a, min, max), x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return run_op("hardshrink",
+                  lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return run_op("softshrink",
+                  lambda a: jnp.where(
+                      a > threshold, a - threshold,
+                      jnp.where(a < -threshold, a + threshold,
+                                jnp.zeros_like(a))), x)
+
+
+def tanhshrink(x, name=None):
+    return run_op("tanhshrink", lambda a: a - jnp.tanh(a), x)
+
+
+def mish(x, name=None):
+    return run_op("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)), x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return run_op("softplus",
+                  lambda a: jnp.where(
+                      beta * a > threshold, a,
+                      (1.0 / beta) * jnp.log1p(jnp.exp(beta * a))), x)
+
+
+def softsign(x, name=None):
+    return run_op("softsign", jax.nn.soft_sign, x)
+
+
+def sigmoid(x, name=None):
+    return run_op("sigmoid", jax.nn.sigmoid, x)
+
+
+def tanh(x, name=None):
+    return run_op("tanh", jnp.tanh, x)
+
+
+def tanh_(x, name=None):
+    from paddle_tpu.core.dispatch import run_op_inplace
+    return run_op_inplace("tanh_", jnp.tanh, x)
+
+
+def log_sigmoid(x, name=None):
+    return run_op("log_sigmoid", jax.nn.log_sigmoid, x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+    return run_op("maxout", f, x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from paddle_tpu.core import dtype as dtype_mod
+    d = dtype_mod.convert_dtype(dtype)
+    def f(a):
+        if d is not None:
+            a = a.astype(d)
+        return jax.nn.softmax(a, axis=axis)
+    return run_op("softmax", f, x)
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    from paddle_tpu.core.dispatch import run_op_inplace
+    return run_op_inplace("softmax_",
+                          lambda a: jax.nn.softmax(a, axis=axis), x)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from paddle_tpu.core import dtype as dtype_mod
+    d = dtype_mod.convert_dtype(dtype)
+    def f(a):
+        if d is not None:
+            a = a.astype(d)
+        return jax.nn.log_softmax(a, axis=axis)
+    return run_op("log_softmax", f, x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from paddle_tpu.core import generator as gen_mod
+    key = gen_mod.next_key()
+    def f(a):
+        g = jax.random.gumbel(key, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(
+                y_hard, idx, jnp.ones((), y.dtype), axis=axis,
+                inplace=False) if hasattr(jnp, "put_along_axis") else \
+                y_hard.at[...].set(
+                    (jax.nn.one_hot(jnp.squeeze(idx, axis), a.shape[axis],
+                                    axis=axis, dtype=y.dtype)))
+            return y_hard + jax.lax.stop_gradient(-y) + y
+        return y
+    return run_op("gumbel_softmax", f, x)
+
+
+def glu(x, axis=-1, name=None):
+    return run_op("glu", lambda a: jax.nn.glu(a, axis=axis), x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return run_op("thresholded_relu",
+                  lambda a: jnp.where(a > threshold, a,
+                                      jnp.asarray(value, a.dtype)), x)

@@ -1,0 +1,276 @@
+"""Common functionals: linear, dropout, embedding, interpolate, normalize,
+cosine_similarity, label_smooth (reference: python/paddle/nn/functional/
+common.py + input.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import generator as gen_mod
+from paddle_tpu.core.dispatch import run_op
+from paddle_tpu.core.tensor import Tensor
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with paddle weight layout [in, out] — a single MXU
+    matmul; bias add fuses in XLA."""
+    if bias is None:
+        return run_op("linear", lambda a, w: jnp.matmul(a, w), x, weight)
+    return run_op("linear",
+                  lambda a, w, b: jnp.matmul(a, w) + b, x, weight, bias)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        return x if mode == "upscale_in_train" else run_op(
+            "dropout_eval", lambda a: a * (1.0 - p), x)
+    key = gen_mod.next_key()
+    def f(a):
+        if axis is None:
+            shape = a.shape
+        else:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = tuple(a.shape[i] if i in axes else 1
+                          for i in range(a.ndim))
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), jnp.zeros((), a.dtype))
+        return jnp.where(keep, a, jnp.zeros((), a.dtype))
+    return run_op("dropout", f, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = gen_mod.next_key()
+    def f(a):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        coef_a = (q + alpha_p ** 2 * q * p) ** -0.5
+        coef_b = -coef_a * alpha_p * p
+        return coef_a * jnp.where(keep, a, jnp.asarray(alpha_p, a.dtype)) \
+            + coef_b
+    return run_op("alpha_dropout", f, x)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def f(ids, w):
+        out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+        return out
+    return run_op("embedding", f, x, weight)
+
+
+def one_hot(x, num_classes, name=None):
+    from paddle_tpu.ops.creation import one_hot as _oh
+    return _oh(x, num_classes)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(a):
+        nrm = jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=axis,
+                                keepdims=True), 1.0 / p)
+        return a / jnp.maximum(nrm, epsilon)
+    return run_op("normalize", f, x)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return run_op("cosine_similarity", f, x1, x2)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(l):
+        k = l.shape[-1]
+        return (1 - epsilon) * l + epsilon / k
+    if prior_dist is not None:
+        return run_op("label_smooth",
+                      lambda l, pd: (1 - epsilon) * l + epsilon * pd,
+                      label, prior_dist)
+    return run_op("label_smooth", f, label)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from paddle_tpu.ops.manipulation import pad as _pad
+    return _pad(x, pad, mode, value, data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    nd = x.ndim - 2
+    if data_format.endswith("C"):
+        spatial = list(x.shape[1:-1])
+    else:
+        spatial = list(x.shape[2:])
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        out_size = [int(s.item() if isinstance(s, Tensor) else s)
+                    for s in (size if isinstance(size, (list, tuple))
+                              else [size] * nd)]
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+            else [scale_factor] * nd
+        out_size = [int(s * f) for s, f in zip(spatial, sf)]
+
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear",
+             "cubic": "cubic"}[mode.lower()]
+
+    def f(a):
+        if data_format.endswith("C"):
+            new_shape = (a.shape[0],) + tuple(out_size) + (a.shape[-1],)
+        else:
+            new_shape = a.shape[:2] + tuple(out_size)
+        if jmode == "nearest" or not align_corners:
+            return jax.image.resize(a, new_shape, method=jmode)
+        # align_corners: do coordinate mapping manually per spatial dim
+        src_sp = spatial
+        dst_sp = out_size
+        out = a
+        offset = 1 if data_format.endswith("C") else 2
+        for d in range(nd):
+            axis = offset + d
+            n_in, n_out = src_sp[d], dst_sp[d]
+            if n_out == 1 or n_in == 1:
+                coords = jnp.zeros(n_out)
+            else:
+                coords = jnp.linspace(0, n_in - 1, n_out)
+            lo = jnp.floor(coords).astype(jnp.int32)
+            hi = jnp.minimum(lo + 1, n_in - 1)
+            w = (coords - lo).astype(a.dtype)
+            shape = [1] * out.ndim
+            shape[axis] = n_out
+            w = w.reshape(shape)
+            out = (jnp.take(out, lo, axis=axis) * (1 - w)
+                   + jnp.take(out, hi, axis=axis) * w)
+        return out
+    return run_op("interpolate", f, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW",
+             name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = jnp.transpose(a, (0, 1, 4, 2, 5, 3))
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = jnp.transpose(a, (0, 1, 3, 2, 4, 5))
+        return a.reshape(n, h * r, w * r, c // (r * r))
+    return run_op("pixel_shuffle", f, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = jnp.transpose(a, (0, 1, 3, 5, 2, 4))
+            return a.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = jnp.transpose(a, (0, 1, 3, 5, 2, 4)).reshape(
+            n, h // r, w // r, c * r * r)
+        return a
+    return run_op("pixel_unshuffle", f, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, groups, c // groups, h, w)
+            return jnp.swapaxes(a, 1, 2).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, groups, c // groups)
+        return jnp.swapaxes(a, 3, 4).reshape(n, h, w, c)
+    return run_op("channel_shuffle", f, x)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    from paddle_tpu.ops.manipulation import unfold as _unfold
+    return _unfold(x, kernel_sizes, strides, paddings, dilations)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) \
+        else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+    dl = dilations if isinstance(dilations, (list, tuple)) \
+        else [dilations] * 2
+    oh, ow = output_sizes
+    def f(a):
+        n, ckk, l = a.shape
+        c = ckk // (ks[0] * ks[1])
+        nh = (oh + pd[0] + pd[2] - dl[0] * (ks[0] - 1) - 1) // st[0] + 1
+        nw = (ow + pd[1] + pd[3] - dl[1] * (ks[1] - 1) - 1) // st[1] + 1
+        a = a.reshape(n, c, ks[0], ks[1], nh, nw)
+        out = jnp.zeros((n, c, oh + pd[0] + pd[2], ow + pd[1] + pd[3]),
+                        a.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                hs = i * dl[0]
+                ws = j * dl[1]
+                out = out.at[:, :, hs:hs + nh * st[0]:st[0],
+                             ws:ws + nw * st[1]:st[1]].add(a[:, :, i, j])
+        return out[:, :, pd[0]:pd[0] + oh, pd[1]:pd[1] + ow]
+    return run_op("fold", f, x)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from paddle_tpu.core import dtype as dtype_mod
+    if maxlen is None:
+        maxlen = int(np.asarray(x._data).max())
+    d = dtype_mod.convert_dtype(dtype)
+    def f(lengths):
+        ids = jnp.arange(maxlen)
+        return (ids[None, :] < lengths[..., None]).astype(d)
+    return run_op("sequence_mask", f, x, differentiable=False)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, *maybe_bias):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if maybe_bias:
+            out = out + maybe_bias[0]
+        return out
+    if bias is not None:
+        return run_op("bilinear", f, x1, x2, weight, bias)
+    return run_op("bilinear", f, x1, x2, weight)

@@ -1,0 +1,162 @@
+"""Convolutions (reference: python/paddle/nn/functional/conv.py over
+phi conv kernels / cuDNN). TPU-native: lax.conv_general_dilated — XLA lowers
+to MXU convolutions; NCHW layouts are transposed by XLA as needed."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import run_op
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 2 * n:  # per-side paddings
+            return tuple(int(x) for x in v)
+        return tuple(int(v[0]) for _ in range(n))
+    return (int(v),) * n
+
+
+def _padding_cfg(padding, n, stride, dilation, ksize):
+    if isinstance(padding, str):
+        p = padding.upper()
+        if p == "SAME":
+            return "SAME"
+        if p == "VALID":
+            return "VALID"
+        raise ValueError(padding)
+    pads = _tuple(padding, n)
+    if len(pads) == n:
+        return [(p, p) for p in pads]
+    return [(pads[2 * i], pads[2 * i + 1]) for i in range(n)]
+
+
+def _conv(name, x, weight, bias, stride, padding, dilation, groups,
+          data_format, n):
+    stride = _tuple(stride, n)
+    dilation = _tuple(dilation, n)
+    channels_last = data_format.endswith("C")
+    spatial = "DHW"[-n:]
+    if channels_last:
+        lhs_spec = "N" + spatial + "C"
+    else:
+        lhs_spec = "NC" + spatial
+    rhs_spec = "OI" + spatial
+    out_spec = lhs_spec
+    pad_cfg = _padding_cfg(padding, n, stride, dilation, None)
+
+    def f(a, w, *maybe_b):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=stride, padding=pad_cfg,
+            rhs_dilation=dilation, feature_group_count=groups,
+            dimension_numbers=(lhs_spec, rhs_spec, out_spec))
+        if maybe_b:
+            b = maybe_b[0]
+            shape = [1] * out.ndim
+            shape[out_spec.index("C")] = b.size
+            out = out + b.reshape(shape)
+        return out
+    if bias is not None:
+        return run_op(name, f, x, weight, bias)
+    return run_op(name, f, x, weight)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    df = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv("conv1d", x, weight, bias, stride, padding, dilation,
+                 groups, df, 1)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv("conv2d", x, weight, bias, stride, padding, dilation,
+                 groups, data_format, 2)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv("conv3d", x, weight, bias, stride, padding, dilation,
+                 groups, data_format, 3)
+
+
+def _conv_transpose(name, x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, data_format, n, output_size):
+    stride = _tuple(stride, n)
+    dilation = _tuple(dilation, n)
+    opad = _tuple(output_padding, n)
+    channels_last = data_format.endswith("C")
+    spatial = "DHW"[-n:]
+    lhs_spec = ("N" + spatial + "C") if channels_last else ("NC" + spatial)
+    rhs_spec = "IO" + spatial  # paddle conv_transpose weight: [in, out/g, *k]
+    out_spec = lhs_spec
+    if isinstance(padding, str):
+        raise ValueError("string padding unsupported for conv_transpose")
+    pads = _tuple(padding, n)
+    if len(pads) == n:
+        pad_pairs = [(p, p) for p in pads]
+    else:
+        pad_pairs = [(pads[2 * i], pads[2 * i + 1]) for i in range(n)]
+    # transposed conv = conv_general_dilated with lhs_dilation
+    ksizes = [int(s) for s in
+              (weight.shape[2:] if True else [])]
+    trans_pads = []
+    for i in range(n):
+        k = (ksizes[i] - 1) * dilation[i] + 1
+        lo = k - 1 - pad_pairs[i][0]
+        hi = k - 1 - pad_pairs[i][1] + opad[i]
+        trans_pads.append((lo, hi))
+
+    def f(a, w, *maybe_b):
+        # weight [in, out/groups, *k] → flip spatial, use as OIHW' with O=out
+        wt = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        if groups > 1:
+            ci = wt.shape[0]
+            co_g = wt.shape[1]
+            wt = wt.reshape((groups, ci // groups, co_g) + wt.shape[2:])
+            wt = jnp.swapaxes(wt, 1, 2)
+            wt = wt.reshape((groups * co_g, ci // groups) + wt.shape[3:])
+        else:
+            wt = jnp.swapaxes(wt, 0, 1)
+        out = jax.lax.conv_general_dilated(
+            a, wt, window_strides=(1,) * n, padding=trans_pads,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            feature_group_count=groups,
+            dimension_numbers=(lhs_spec, "OI" + spatial, out_spec))
+        if maybe_b:
+            b = maybe_b[0]
+            shape = [1] * out.ndim
+            shape[out_spec.index("C")] = b.size
+            out = out + b.reshape(shape)
+        return out
+    if bias is not None:
+        return run_op(name, f, x, weight, bias)
+    return run_op(name, f, x, weight)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    df = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv_transpose("conv1d_transpose", x, weight, bias, stride,
+                           padding, output_padding, dilation, groups, df, 1,
+                           output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose("conv2d_transpose", x, weight, bias, stride,
+                           padding, output_padding, dilation, groups,
+                           data_format, 2, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose("conv3d_transpose", x, weight, bias, stride,
+                           padding, output_padding, dilation, groups,
+                           data_format, 3, output_size)

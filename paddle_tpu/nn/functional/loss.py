@@ -1,0 +1,345 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import run_op
+from paddle_tpu.core.tensor import Tensor
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    def f(logits, lab, *maybe_w):
+        ax = axis % logits.ndim
+        logp = jax.nn.log_softmax(logits, axis=ax) if use_softmax \
+            else jnp.log(jnp.maximum(logits, 1e-30))
+        if soft_label or (lab.ndim == logits.ndim
+                          and lab.shape[ax] == logits.shape[ax]
+                          and jnp.issubdtype(lab.dtype, jnp.floating)):
+            tgt = lab
+            if label_smoothing > 0:
+                k = logits.shape[ax]
+                tgt = (1 - label_smoothing) * tgt + label_smoothing / k
+            loss = -jnp.sum(tgt * logp, axis=ax)
+            if maybe_w:
+                loss = loss * jnp.sum(tgt * maybe_w[0], axis=ax)
+            return _reduce(loss, reduction)
+        lab_idx = lab
+        if lab_idx.ndim == logits.ndim:
+            lab_idx = jnp.squeeze(lab_idx, ax)
+        lab_idx = lab_idx.astype(jnp.int32)
+        valid = lab_idx != ignore_index
+        safe = jnp.where(valid, lab_idx, 0)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe, ax), axis=ax)
+        picked = jnp.squeeze(picked, ax)
+        if label_smoothing > 0:
+            k = logits.shape[ax]
+            smooth = jnp.mean(logp, axis=ax)
+            picked = (1 - label_smoothing) * picked + label_smoothing * smooth
+        loss = -picked
+        if maybe_w:
+            w = jnp.take(maybe_w[0], safe)
+            loss = loss * w
+            loss = jnp.where(valid, loss, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(
+                    jnp.sum(jnp.where(valid, w, 0.0)), 1e-12)
+            return _reduce(loss, reduction)
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(valid.astype(loss.dtype)), 1.0)
+        return _reduce(loss, reduction)
+    extras = [weight] if weight is not None else []
+    return run_op("cross_entropy", f, input, label, *extras)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    from .activation import softmax as _softmax
+    loss = loss.unsqueeze(axis)
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    return _nll(input, label, weight, ignore_index, reduction)
+
+
+def _nll(input, label, weight, ignore_index, reduction):
+    def f(logp, lab, *maybe_w):
+        lab_idx = lab.astype(jnp.int32)
+        valid = lab_idx != ignore_index
+        safe = jnp.where(valid, lab_idx, 0)
+        picked = jnp.take_along_axis(logp, safe[:, None], axis=1)[:, 0] \
+            if logp.ndim == 2 else jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, 1), axis=1).squeeze(1)
+        loss = -picked
+        if maybe_w:
+            w = jnp.take(maybe_w[0], safe)
+            loss = loss * w
+            loss = jnp.where(valid, loss, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(
+                    jnp.sum(jnp.where(valid, w, 0.0)), 1e-12)
+            return _reduce(loss, reduction)
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(valid.astype(loss.dtype)), 1.0)
+        return _reduce(loss, reduction)
+    extras = [weight] if weight is not None else []
+    return run_op("nll_loss", f, input, label, *extras)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return run_op("mse_loss",
+                  lambda a, b: _reduce(jnp.square(a - b), reduction),
+                  input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return run_op("l1_loss",
+                  lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                  input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(loss, reduction)
+    return run_op("smooth_l1_loss", f, input, label)
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d <= delta, 0.5 * d * d,
+                         delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+    return run_op("huber_loss", f, input, label)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    def f(p, y, *maybe_w):
+        eps = 1e-12
+        loss = -(y * jnp.log(jnp.maximum(p, eps))
+                 + (1 - y) * jnp.log(jnp.maximum(1 - p, eps)))
+        if maybe_w:
+            loss = loss * maybe_w[0]
+        return _reduce(loss, reduction)
+    extras = [weight] if weight is not None else []
+    return run_op("bce", f, input, label, *extras)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    def f(z, y, *extras_arr):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = extras_arr[i]
+            i += 1
+        if pos_weight is not None:
+            pw = extras_arr[i]
+        # stable: max(z,0) - z*y + log(1+exp(-|z|))
+        base = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if pw is not None:
+            logsig = jax.nn.log_sigmoid(z)
+            log1msig = jax.nn.log_sigmoid(-z)
+            base = -(pw * y * logsig + (1 - y) * log1msig)
+        if w is not None:
+            base = base * w
+        return _reduce(base, reduction)
+    extras = [t for t in (weight, pos_weight) if t is not None]
+    return run_op("bce_logits", f, logit, label, *extras)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def f(lp, t):
+        tgt = jnp.exp(t) if log_target else t
+        logt = t if log_target else jnp.log(jnp.maximum(t, 1e-12))
+        loss = tgt * (logt - lp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / lp.shape[0]
+        return _reduce(loss, reduction)
+    return run_op("kl_div", f, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def f(a, b, y):
+        loss = jnp.maximum(-y * (a - b) + margin, 0.0)
+        return _reduce(loss, reduction)
+    return run_op("margin_ranking_loss", f, input, other, label)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    def f(a, y):
+        loss = jnp.where(y == 1, a, jnp.maximum(margin - a, 0.0))
+        return _reduce(loss, reduction)
+    return run_op("hinge_embedding_loss", f, input, label)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+        return _reduce(loss, reduction)
+    return run_op("cosine_embedding_loss", f, input1, input2, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    def f(a, pos, neg):
+        def dist(u, v):
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(u - v) + epsilon, p),
+                                     -1), 1.0 / p)
+        d_pos = dist(a, pos)
+        d_neg = dist(a, neg)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dist(pos, neg))
+        loss = jnp.maximum(d_pos - d_neg + margin, 0.0)
+        return _reduce(loss, reduction)
+    return run_op("triplet_margin_loss", f, input, positive, negative)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    def f(z, y, *maybe_w):
+        loss = -(y * jax.nn.log_sigmoid(z)
+                 + (1 - y) * jax.nn.log_sigmoid(-z))
+        if maybe_w:
+            loss = loss * maybe_w[0]
+        loss = jnp.mean(loss, -1)
+        return _reduce(loss, reduction)
+    extras = [weight] if weight is not None else []
+    return run_op("multi_label_soft_margin_loss", f, input, label, *extras)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def f(z, y):
+        return _reduce(jnp.log1p(jnp.exp(-y * z)), reduction)
+    return run_op("soft_margin_loss", f, input, label)
+
+
+def square_error_cost(input, label):
+    return run_op("square_error_cost",
+                  lambda a, b: jnp.square(a - b), input, label)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def f(p, y):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+    return run_op("log_loss", f, input, label)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(z, y, *maybe_n):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if maybe_n:
+            loss = loss / maybe_n[0]
+        return _reduce(loss, reduction)
+    extras = [normalizer] if normalizer is not None else []
+    return run_op("sigmoid_focal_loss", f, logit, label, *extras)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard forward algorithm in log space (reference:
+    warpctc binding — here a lax.scan over time, XLA-compilable)."""
+    def f(lp, lab, in_len, lab_len):
+        # lp: [T, B, C] log-softmaxed or logits; normalize to log-probs
+        lp = jax.nn.log_softmax(lp, axis=-1)
+        T, B, C = lp.shape
+        S = lab.shape[1]
+        ext = jnp.full((B, 2 * S + 1), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        L = 2 * S + 1
+        neg_inf = jnp.asarray(-1e30, lp.dtype)
+        alpha0 = jnp.full((B, L), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, jnp.arange(B), ext[:, 0]])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(lab_len > 0, lp[0, jnp.arange(B), ext[:, 1]], neg_inf))
+        same = jnp.concatenate(
+            [jnp.ones((B, 2), bool),
+             ext[:, 2:] == ext[:, :-2]], axis=1)
+        def step(alpha, lp_t):
+            a_prev1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf), alpha[:, :-1]], 1)
+            a_prev2 = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf), alpha[:, :-2]], 1)
+            a_prev2 = jnp.where(same, neg_inf, a_prev2)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a_prev1), a_prev2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return merged + emit, None
+        def scan_step(alpha_t, lp_t):
+            t, alpha = alpha_t
+            new_alpha, _ = step(alpha, lp_t)
+            alpha = jnp.where(t < in_len[:, None] - 1 + 1, new_alpha, alpha)
+            return (t + 1, alpha), None
+        (_, alphaT), _ = jax.lax.scan(scan_step, (1, alpha0), lp[1:])
+        idx_last = 2 * lab_len
+        idx_prev = jnp.maximum(2 * lab_len - 1, 0)
+        bidx = jnp.arange(B)
+        ll = jnp.logaddexp(alphaT[bidx, idx_last], alphaT[bidx, idx_prev])
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len, 1))
+        return _reduce(loss, reduction)
+    return run_op("ctc_loss", f, log_probs, labels, input_lengths,
+                  label_lengths)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def f(a, p, y):
+        sim = a @ p.T
+        b = a.shape[0]
+        tgt = (y[:, None] == y[None, :]).astype(a.dtype)
+        tgt = tgt / jnp.sum(tgt, -1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, -1)
+        ce = -jnp.mean(jnp.sum(tgt * logp, -1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, -1))
+                        + jnp.mean(jnp.sum(p * p, -1))) * 0.25
+        return ce + reg
+    return run_op("npair_loss", f, anchor, positive, labels)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def f(p, y):
+        yf = jax.nn.one_hot(y.squeeze(-1), p.shape[-1], dtype=p.dtype)
+        inter = jnp.sum(p * yf, axis=-1)
+        union = jnp.sum(p, -1) + jnp.sum(yf, -1)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return run_op("dice_loss", f, input, label)

@@ -1,0 +1,196 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py
+over phi batch_norm/layer_norm kernels; rms_norm from
+incubate/nn/functional/fused_rms_norm — on TPU XLA fuses these into a few
+HBM-bandwidth-bound passes, no hand-written kernel needed)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import run_op
+from paddle_tpu.core.tensor import Tensor
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    ch_axis = 1 if not data_format.endswith("C") or data_format in (
+        "NCHW", "NCL", "NCDHW") else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    use_batch_stats = training and not (use_global_stats or False)
+
+    if use_batch_stats:
+        # update running stats eagerly (side effect, matches reference)
+        def stats(a):
+            m = jnp.mean(a, axis=reduce_axes)
+            v = jnp.var(a, axis=reduce_axes)
+            return m, v
+        m_arr, v_arr = stats(x._data)
+        if running_mean is not None:
+            running_mean._assign_array(
+                (momentum * running_mean._data
+                 + (1 - momentum) * m_arr).astype(running_mean._data.dtype))
+        if running_var is not None:
+            n = 1
+            for i in reduce_axes:
+                n *= x.shape[i]
+            unbiased = v_arr * n / max(n - 1, 1)
+            running_var._assign_array(
+                (momentum * running_var._data
+                 + (1 - momentum) * unbiased).astype(running_var._data.dtype))
+
+        def f(a, *wb):
+            m = jnp.mean(a, axis=reduce_axes, keepdims=True)
+            v = jnp.var(a, axis=reduce_axes, keepdims=True)
+            out = (a - m) * jax.lax.rsqrt(v + epsilon)
+            return _affine(out, wb, ch_axis)
+    else:
+        def f(a, rm, rv, *wb):
+            shape = [1] * a.ndim
+            shape[ch_axis] = rm.size
+            out = (a - rm.reshape(shape)) * jax.lax.rsqrt(
+                rv.reshape(shape) + epsilon)
+            return _affine(out, wb, ch_axis)
+
+    def _affine(out, wb, ch_axis):
+        shape = [1] * out.ndim
+        if len(wb) >= 1 and wb[0] is not None:
+            shape[ch_axis] = wb[0].size
+            out = out * wb[0].reshape(shape)
+        if len(wb) >= 2 and wb[1] is not None:
+            shape[ch_axis] = wb[1].size
+            out = out + wb[1].reshape(shape)
+        return out
+
+    extras = [t for t in (weight, bias) if t is not None]
+    if use_batch_stats:
+        return run_op("batch_norm", f, x, *extras)
+    return run_op("batch_norm_infer", f, x, running_mean, running_var,
+                  *extras)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(tuple(normalized_shape))
+    axes = tuple(range(x.ndim - n_axes, x.ndim))
+
+    def f(a, *wb):
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) * jax.lax.rsqrt(v + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+
+    extras = [t for t in (weight, bias) if t is not None]
+    return run_op("layer_norm", f, x, *extras)
+
+
+def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1,
+             name=None):
+    """Root-mean-square norm (reference: incubate fused_rms_norm)."""
+    axes = (begin_norm_axis,) if isinstance(begin_norm_axis, int) \
+        else tuple(begin_norm_axis)
+
+    def f(a, *wb):
+        # compute in f32 for bf16 stability (fused_rms_norm does the same)
+        h = a.astype(jnp.float32) if a.dtype in (jnp.bfloat16, jnp.float16) \
+            else a
+        ms = jnp.mean(jnp.square(h), axis=axes, keepdims=True)
+        out = (h * jax.lax.rsqrt(ms + epsilon)).astype(a.dtype)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+
+    extras = [t for t in (weight, bias) if t is not None]
+    return run_op("rms_norm", f, x, *extras)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9,
+                  eps=1e-05, data_format="NCHW", name=None):
+    ch_axis = 1 if not data_format.endswith("C") or data_format.startswith(
+        "NC") else x.ndim - 1
+    axes = tuple(i for i in range(2, x.ndim)) if ch_axis == 1 else \
+        tuple(i for i in range(1, x.ndim - 1))
+
+    def f(a, *wb):
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) * jax.lax.rsqrt(v + eps)
+        shape = [1] * a.ndim
+        i = 0
+        if weight is not None:
+            shape[ch_axis] = wb[i].size
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            shape[ch_axis] = wb[i].size
+            out = out + wb[i].reshape(shape)
+        return out
+
+    extras = [t for t in (weight, bias) if t is not None]
+    return run_op("instance_norm", f, x, *extras)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    channels_last = data_format.endswith("C") and data_format != "NCHW" \
+        and data_format != "NCL" and data_format != "NCDHW"
+    ch_axis = x.ndim - 1 if channels_last else 1
+
+    def f(a, *wb):
+        if channels_last:
+            a_m = jnp.moveaxis(a, -1, 1)
+        else:
+            a_m = a
+        n, c = a_m.shape[0], a_m.shape[1]
+        g = num_groups
+        grouped = a_m.reshape((n, g, c // g) + a_m.shape[2:])
+        axes = tuple(range(2, grouped.ndim))
+        m = jnp.mean(grouped, axis=axes, keepdims=True)
+        v = jnp.var(grouped, axis=axes, keepdims=True)
+        out = ((grouped - m) * jax.lax.rsqrt(v + epsilon)).reshape(a_m.shape)
+        shape = [1] * a_m.ndim
+        i = 0
+        if weight is not None:
+            shape[1] = wb[i].size
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            shape[1] = wb[i].size
+            out = out + wb[i].reshape(shape)
+        if channels_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    extras = [t for t in (weight, bias) if t is not None]
+    return run_op("group_norm", f, x, *extras)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def f(a):
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        sq = jnp.square(a)
+        moved = jnp.moveaxis(sq, ch_axis, -1)
+        pad = [(0, 0)] * (moved.ndim - 1) + [(size // 2, (size - 1) // 2)]
+        padded = jnp.pad(moved, pad)
+        win = jax.lax.reduce_window(
+            padded, 0.0, jax.lax.add,
+            (1,) * (moved.ndim - 1) + (size,),
+            (1,) * moved.ndim, "VALID")
+        win = jnp.moveaxis(win, -1, ch_axis)
+        div = jnp.power(k + alpha * win, beta)
+        return a / div
+    return run_op("local_response_norm", f, x)

@@ -1,0 +1,210 @@
+"""Pooling functionals (reference: python/paddle/nn/functional/pooling.py).
+TPU-native: lax.reduce_window."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import run_op
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _window(a_ndim, n, ksize, stride, channels_last):
+    if channels_last:
+        dims = (1,) + ksize + (1,)
+        strides = (1,) + stride + (1,)
+    else:
+        dims = (1, 1) + ksize
+        strides = (1, 1) + stride
+    return dims, strides
+
+
+def _pads(padding, n, channels_last, ceil_mode, shape, ksize, stride):
+    if isinstance(padding, str):
+        return padding.upper()
+    p = _tuple(padding, n)
+    if len(p) == n:
+        pairs = [(x, x) for x in p]
+    else:
+        pairs = [(p[2 * i], p[2 * i + 1]) for i in range(n)]
+    if ceil_mode:
+        # extend the upper padding so the last partial window is included
+        sp = shape[1:-1] if channels_last else shape[2:]
+        new_pairs = []
+        for i, (lo, hi) in enumerate(pairs):
+            size = sp[i] + lo + hi
+            rem = (size - ksize[i]) % stride[i]
+            extra = (stride[i] - rem) % stride[i] if rem else 0
+            new_pairs.append((lo, hi + extra))
+        pairs = new_pairs
+    if channels_last:
+        return [(0, 0)] + pairs + [(0, 0)]
+    return [(0, 0), (0, 0)] + pairs
+
+
+def _pool(name, x, n, kind, kernel_size, stride, padding, ceil_mode,
+          channels_last, exclusive=True, divisor_override=None):
+    ksize = _tuple(kernel_size, n)
+    stride = _tuple(stride if stride is not None else kernel_size, n)
+    def f(a):
+        dims, strides = _window(a.ndim, n, ksize, stride, channels_last)
+        pads = _pads(padding, n, channels_last, ceil_mode, a.shape, ksize,
+                     stride)
+        if kind == "max":
+            init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) \
+                else jnp.iinfo(a.dtype).min
+            return jax.lax.reduce_window(a, init, jax.lax.max, dims, strides,
+                                         pads)
+        ssum = jax.lax.reduce_window(a, 0.0, jax.lax.add,
+                                     dims, strides, pads)
+        if divisor_override:
+            return ssum / divisor_override
+        if exclusive and not isinstance(pads, str):
+            ones = jnp.ones_like(a)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims,
+                                        strides, pads)
+            return ssum / cnt
+        return ssum / np.prod(ksize)
+    return run_op(name, f, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    out = _pool("max_pool1d", x, 1, "max", kernel_size, stride, padding,
+                ceil_mode, data_format.endswith("C") and data_format != "NCL"
+                and data_format != "NCW")
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool("max_pool2d", x, 2, "max", kernel_size, stride, padding,
+                 ceil_mode, data_format == "NHWC")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool("max_pool3d", x, 3, "max", kernel_size, stride, padding,
+                 ceil_mode, data_format == "NDHWC")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool("avg_pool1d", x, 1, "avg", kernel_size, stride, padding,
+                 ceil_mode, False, exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool("avg_pool2d", x, 2, "avg", kernel_size, stride, padding,
+                 ceil_mode, data_format == "NHWC", exclusive,
+                 divisor_override)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool("avg_pool3d", x, 3, "avg", kernel_size, stride, padding,
+                 ceil_mode, data_format == "NDHWC", exclusive,
+                 divisor_override)
+
+
+def _adaptive_pool(name, x, n, kind, output_size, channels_last):
+    osize = _tuple(output_size, n)
+    def f(a):
+        sp = a.shape[1:-1] if channels_last else a.shape[2:]
+        # adaptive pooling with uniform windows when divisible; else use
+        # the mean of gathered per-bin slices (loop is static & small)
+        if all(s % o == 0 for s, o in zip(sp, osize)):
+            ksize = tuple(s // o for s, o in zip(sp, osize))
+            dims, strides = _window(a.ndim, n, ksize, ksize, channels_last)
+            if kind == "max":
+                return jax.lax.reduce_window(
+                    a, -jnp.inf, jax.lax.max, dims, strides, "VALID")
+            ssum = jax.lax.reduce_window(a, 0.0, jax.lax.add, dims,
+                                         strides, "VALID")
+            return ssum / np.prod(ksize)
+        out = a
+        offset = 1 if channels_last else 2
+        for d in range(n):
+            axis = offset + d
+            in_s, out_s = sp[d], osize[d]
+            starts = [int(np.floor(i * in_s / out_s)) for i in range(out_s)]
+            ends = [int(np.ceil((i + 1) * in_s / out_s))
+                    for i in range(out_s)]
+            slices = []
+            for s0, e0 in zip(starts, ends):
+                sl = jax.lax.slice_in_dim(out, s0, e0, axis=axis)
+                red = (jnp.max if kind == "max" else jnp.mean)(
+                    sl, axis=axis, keepdims=True)
+                slices.append(red)
+            out = jnp.concatenate(slices, axis=axis)
+        return out
+    return run_op(name, f, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool("adaptive_avg_pool1d", x, 1, "avg", output_size,
+                          False)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool("adaptive_avg_pool2d", x, 2, "avg", output_size,
+                          data_format == "NHWC")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool("adaptive_avg_pool3d", x, 3, "avg", output_size,
+                          data_format == "NDHWC")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool("adaptive_max_pool1d", x, 1, "max", output_size,
+                          False)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool("adaptive_max_pool2d", x, 2, "max", output_size,
+                          False)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool("adaptive_max_pool3d", x, 3, "max", output_size,
+                          False)
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    p = float(norm_type)
+    ksize = _tuple(kernel_size, 1)
+    stride_t = _tuple(stride if stride is not None else kernel_size, 1)
+    def f(a):
+        dims, strides = _window(a.ndim, 1, ksize, stride_t, False)
+        pads = _pads(padding, 1, False, ceil_mode, a.shape, ksize, stride_t)
+        s = jax.lax.reduce_window(jnp.power(jnp.abs(a), p), 0.0,
+                                  jax.lax.add, dims, strides, pads)
+        return jnp.power(s, 1.0 / p)
+    return run_op("lp_pool1d", f, x)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    p = float(norm_type)
+    ksize = _tuple(kernel_size, 2)
+    stride_t = _tuple(stride if stride is not None else kernel_size, 2)
+    def f(a):
+        dims, strides = _window(a.ndim, 2, ksize, stride_t,
+                                data_format == "NHWC")
+        pads = _pads(padding, 2, data_format == "NHWC", ceil_mode, a.shape,
+                     ksize, stride_t)
+        s = jax.lax.reduce_window(jnp.power(jnp.abs(a), p), 0.0,
+                                  jax.lax.add, dims, strides, pads)
+        return jnp.power(s, 1.0 / p)
+    return run_op("lp_pool2d", f, x)

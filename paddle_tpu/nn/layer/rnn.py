@@ -1,0 +1,338 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py).
+
+TPU-native: the time loop is jax.lax.scan (compiled once, no per-step
+dispatch); gates are fused GEMMs on the MXU.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import run_op
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from .layers import Layer, LayerList
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from paddle_tpu.ops.creation import full
+        b = batch_ref.shape[batch_dim_idx]
+        return full([b, self.hidden_size], init_value,
+                    dtype or "float32")
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            (hidden_size, input_size), weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            (hidden_size, hidden_size), weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            (hidden_size,), bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            (hidden_size,), bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        def f(x, h, wi, wh, bi, bh):
+            z = x @ wi.T + bi + h @ wh.T + bh
+            return jnp.tanh(z) if self.activation == "tanh" \
+                else jax.nn.relu(z)
+        h = run_op("simple_rnn_cell", f, inputs, states, self.weight_ih,
+                   self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=0, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            (4 * hidden_size, input_size), weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            (4 * hidden_size, hidden_size), weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            (4 * hidden_size,), bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            (4 * hidden_size,), bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+        def f(x, hh, cc, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + hh @ wh.T + bh
+            i, fg, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            fg = jax.nn.sigmoid(fg)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            new_c = fg * cc + i * g
+            new_h = o * jnp.tanh(new_c)
+            return new_h, new_c
+        new_h, new_c = run_op("lstm_cell", f, inputs, h, c, self.weight_ih,
+                              self.weight_hh, self.bias_ih, self.bias_hh)
+        return new_h, (new_h, new_c)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            (3 * hidden_size, input_size), weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            (3 * hidden_size, hidden_size), weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            (3 * hidden_size,), bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            (3 * hidden_size,), bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        def f(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            i_r, i_z, i_n = jnp.split(gi, 3, -1)
+            h_r, h_z, h_n = jnp.split(gh, 3, -1)
+            r = jax.nn.sigmoid(i_r + h_r)
+            z = jax.nn.sigmoid(i_z + h_z)
+            n = jnp.tanh(i_n + r * h_n)
+            return (1 - z) * n + z * h
+        h = run_op("gru_cell", f, inputs, states, self.weight_ih,
+                   self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class RNN(Layer):
+    """Wrap a cell into a scan over time (reference RNN wrapper)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        time_axis = 0 if self.time_major else 1
+        steps = inputs.shape[time_axis]
+        outputs = []
+        states = initial_states
+        idx = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        for i in idx:
+            xt = inputs[:, i] if time_axis == 1 else inputs[i]
+            out, states = self.cell(xt, states)
+            outputs.append(out)
+        if self.is_reverse:
+            outputs = outputs[::-1]
+        from paddle_tpu.ops.manipulation import stack
+        return stack(outputs, axis=time_axis), states
+
+
+class _RNNBase(Layer):
+    """Multi-layer (bi)directional recurrent net over lax.scan."""
+
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None,
+                 activation=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        num_dir = 2 if self.bidirect else 1
+        cell_cls = {"RNN_TANH": SimpleRNNCell, "RNN_RELU": SimpleRNNCell,
+                    "LSTM": LSTMCell, "GRU": GRUCell}[self.MODE]
+        self.cells = LayerList()
+        for layer in range(num_layers):
+            for d in range(num_dir):
+                in_sz = input_size if layer == 0 else hidden_size * num_dir
+                kw = {}
+                if self.MODE.startswith("RNN"):
+                    kw["activation"] = "tanh" if self.MODE == "RNN_TANH" \
+                        else "relu"
+                self.cells.append(cell_cls(in_sz, hidden_size,
+                                           weight_ih_attr, weight_hh_attr,
+                                           bias_ih_attr, bias_hh_attr, **kw))
+
+    def _scan_dir(self, cell, x_tmajor, init, reverse):
+        """x_tmajor: [T, B, C] -> outputs [T, B, H], final state."""
+        is_lstm = self.MODE == "LSTM"
+        wi, wh = cell.weight_ih, cell.weight_hh
+        bi, bh = cell.bias_ih, cell.bias_hh
+        def f(x, wi_a, wh_a, bi_a, bh_a, *init_arrays):
+            def step(carry, xt):
+                if is_lstm:
+                    h, c = carry
+                    gates = xt @ wi_a.T + bi_a + h @ wh_a.T + bh_a
+                    i, fg, g, o = jnp.split(gates, 4, -1)
+                    i, fg, o = (jax.nn.sigmoid(v) for v in (i, fg, o))
+                    g = jnp.tanh(g)
+                    nc = fg * c + i * g
+                    nh = o * jnp.tanh(nc)
+                    return (nh, nc), nh
+                if self.MODE == "GRU":
+                    h = carry
+                    gi = xt @ wi_a.T + bi_a
+                    gh = h @ wh_a.T + bh_a
+                    i_r, i_z, i_n = jnp.split(gi, 3, -1)
+                    h_r, h_z, h_n = jnp.split(gh, 3, -1)
+                    r = jax.nn.sigmoid(i_r + h_r)
+                    z = jax.nn.sigmoid(i_z + h_z)
+                    n = jnp.tanh(i_n + r * h_n)
+                    nh = (1 - z) * n + z * h
+                    return nh, nh
+                h = carry
+                z = xt @ wi_a.T + bi_a + h @ wh_a.T + bh_a
+                nh = jnp.tanh(z) if self.MODE == "RNN_TANH" \
+                    else jax.nn.relu(z)
+                return nh, nh
+            carry0 = (init_arrays[0], init_arrays[1]) if is_lstm \
+                else init_arrays[0]
+            carry, ys = jax.lax.scan(step, carry0, x, reverse=reverse)
+            if reverse:
+                pass
+            if is_lstm:
+                return ys, carry[0], carry[1]
+            return ys, carry
+        init_list = list(init) if is_lstm else [init]
+        outs = run_op(f"{self.MODE.lower()}_scan", f, x_tmajor, wi, wh, bi,
+                      bh, *init_list)
+        if is_lstm:
+            ys, h, c = outs
+            return ys, (h, c)
+        ys, h = outs
+        return ys, h
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from paddle_tpu.ops.creation import zeros
+        from paddle_tpu.ops.manipulation import concat, stack, transpose
+        x = inputs if self.time_major else transpose(inputs, [1, 0, 2])
+        num_dir = 2 if self.bidirect else 1
+        b = x.shape[1]
+        is_lstm = self.MODE == "LSTM"
+        if initial_states is None:
+            def z():
+                return zeros([self.num_layers * num_dir, b,
+                              self.hidden_size], dtype=x.dtype)
+            initial_states = (z(), z()) if is_lstm else z()
+        final_h, final_c = [], []
+        out = x
+        for layer in range(self.num_layers):
+            dir_outs = []
+            for d in range(num_dir):
+                cell = self.cells[layer * num_dir + d]
+                sidx = layer * num_dir + d
+                if is_lstm:
+                    init = (initial_states[0][sidx], initial_states[1][sidx])
+                else:
+                    init = initial_states[sidx]
+                ys, state = self._scan_dir(cell, out, init, reverse=(d == 1))
+                dir_outs.append(ys)
+                if is_lstm:
+                    final_h.append(state[0])
+                    final_c.append(state[1])
+                else:
+                    final_h.append(state)
+            out = dir_outs[0] if num_dir == 1 else concat(dir_outs, axis=-1)
+            if self.dropout > 0 and layer < self.num_layers - 1:
+                out = F.dropout(out, self.dropout, training=self.training)
+        outputs = out if self.time_major else transpose(out, [1, 0, 2])
+        h_stack = stack(final_h, axis=0)
+        if is_lstm:
+            c_stack = stack(final_c, axis=0)
+            return outputs, (h_stack, c_stack)
+        return outputs, h_stack
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        self.MODE = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kw)
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from paddle_tpu.ops.manipulation import concat
+        if initial_states is None:
+            states_fw = states_bw = None
+        else:
+            states_fw, states_bw = initial_states
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw)
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

@@ -1,0 +1,208 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py over
+phi creation kernels — full_kernel, arange_kernel, etc.)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import dtype as dtype_mod
+from paddle_tpu.core.dispatch import run_op
+from paddle_tpu.core.tensor import Tensor
+
+
+def _dt(dtype, default_float=True):
+    d = dtype_mod.convert_dtype(dtype)
+    if d is None and default_float:
+        d = dtype_mod.get_default_dtype()
+    return d
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+def _shape_tuple(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._data if isinstance(s, Tensor) else s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor._wrap(jnp.zeros(_shape_tuple(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor._wrap(jnp.ones(_shape_tuple(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    d = _dt(dtype, default_float=False)
+    if d is None:
+        # paddle.full defaults to float32 for numeric fills, bool for bool
+        d = dtype_mod.bool_ if isinstance(fill_value, bool) \
+            else dtype_mod.get_default_dtype()
+    return Tensor._wrap(jnp.full(_shape_tuple(shape), fill_value, d))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor._wrap(jnp.zeros_like(x._data, dtype=_dt(dtype, False)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor._wrap(jnp.ones_like(x._data, dtype=_dt(dtype, False)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor._wrap(
+        jnp.full_like(x._data, fill_value, dtype=_dt(dtype, False)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    d = _dt(dtype, default_float=False)
+    if d is None:
+        d = np.dtype(np.int64) if all(
+            isinstance(v, (int, np.integer)) for v in (start, end, step)) \
+            else dtype_mod.get_default_dtype()
+    return Tensor._wrap(jnp.arange(start, end, step, dtype=d))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    return Tensor._wrap(jnp.linspace(_v(start), _v(stop), int(_v(num)),
+                                     dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    return Tensor._wrap(jnp.logspace(_v(start), _v(stop), int(_v(num)),
+                                     base=_v(base), dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor._wrap(jnp.eye(int(num_rows),
+                                int(num_columns) if num_columns else None,
+                                dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def f(a):
+        if a.ndim == 1 and padding_value != 0:
+            n = a.shape[0] + abs(offset)
+            base = jnp.full((n, n), padding_value, a.dtype)
+            idx = jnp.arange(a.shape[0])
+            r = idx if offset >= 0 else idx - offset
+            c = idx + offset if offset >= 0 else idx
+            return base.at[r, c].set(a)
+        return jnp.diag(a, k=offset)
+    return run_op("diag", f, x)
+
+
+def diagflat(x, offset=0, name=None):
+    return run_op("diagflat", lambda a: jnp.diagflat(a, k=offset), x)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def f(a):
+        n = a.shape[-1] + abs(offset)
+        out_shape = a.shape[:-1] + (n, n)
+        base = jnp.zeros(out_shape, a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx if offset >= 0 else idx - offset
+        c = idx + offset if offset >= 0 else idx
+        out = base.at[..., r, c].set(a)
+        nd = out.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        # place the two diag dims at dim1/dim2
+        order = []
+        src = iter(perm)
+        for i in range(nd):
+            if i == d1:
+                order.append(nd - 2)
+            elif i == d2:
+                order.append(nd - 1)
+            else:
+                order.append(next(src))
+        return jnp.transpose(out, order)
+    return run_op("diag_embed", f, x)
+
+
+def meshgrid(*args, **kwargs):
+    tensors = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) \
+        else args
+    outs = run_op("meshgrid",
+                  lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")),
+                  *tensors)
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def tril(x, diagonal=0, name=None):
+    return run_op("tril", lambda a: jnp.tril(a, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return run_op("triu", lambda a: jnp.triu(a, k=diagonal), x)
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor._wrap(jnp.asarray(np.stack([r, c]),
+                                    dtype=dtype_mod.convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor._wrap(jnp.asarray(np.stack([r, c]),
+                                    dtype=dtype_mod.convert_dtype(dtype)))
+
+
+def assign(x, output=None):
+    src = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if output is None:
+        return Tensor._wrap(src)
+    output._assign_array(src.astype(output._data.dtype))
+    return output
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def complex(real, imag, name=None):
+    return run_op("complex", lambda r, i: jax.lax.complex(r, i), real, imag)
+
+
+def polar(abs, angle, name=None):
+    return run_op("polar",
+                  lambda r, t: jax.lax.complex(r * jnp.cos(t),
+                                               r * jnp.sin(t)),
+                  abs, angle)
+
+
+def one_hot(x, num_classes, name=None):
+    return run_op("one_hot",
+                  lambda a: jax.nn.one_hot(
+                      a, num_classes, dtype=dtype_mod.get_default_dtype()),
+                  x, differentiable=False)

@@ -1,0 +1,339 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py over phi
+matmul/blas/lapack kernels — on TPU these all lower to MXU matmuls or XLA
+linalg custom calls)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import dtype as dtype_mod
+from paddle_tpu.core.dispatch import run_op
+from paddle_tpu.core.tensor import Tensor
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return run_op("matmul", f, x, y)
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return run_op("bmm", jnp.matmul, x, y)
+
+
+def mv(x, vec, name=None):
+    return run_op("mv", jnp.matmul, x, vec)
+
+
+def dot(x, y, name=None):
+    return run_op("dot", lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+
+
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis
+        if ax == 9:
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return run_op("cross", f, x, y)
+
+
+def multi_dot(x, name=None):
+    return run_op("multi_dot", lambda *xs: jnp.linalg.multi_dot(xs),
+                  *list(x))
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def f(a):
+        if p is None or p == "fro":
+            if axis is None:
+                return jnp.sqrt(jnp.sum(jnp.square(a)))
+            return jnp.linalg.norm(a, ord=None, axis=_ax(axis),
+                                   keepdims=keepdim)
+        if p == "nuc":
+            return jnp.linalg.norm(a, ord="nuc", axis=_ax(axis),
+                                   keepdims=keepdim)
+        if p == np.inf or p == float("inf"):
+            if axis is None:
+                return jnp.max(jnp.abs(a))
+            return jnp.linalg.norm(a, ord=np.inf, axis=_ax(axis),
+                                   keepdims=keepdim)
+        if p == -np.inf or p == float("-inf"):
+            if axis is None:
+                return jnp.min(jnp.abs(a))
+            return jnp.linalg.norm(a, ord=-np.inf, axis=_ax(axis),
+                                   keepdims=keepdim)
+        if axis is None:
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(a), p)), 1.0 / p)
+        return jnp.linalg.norm(a, ord=p, axis=_ax(axis), keepdims=keepdim)
+    def _ax(axis):
+        if isinstance(axis, (list, tuple)):
+            return tuple(axis)
+        return axis
+    return run_op("norm", f, x)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return run_op("vector_norm",
+                  lambda a: jnp.linalg.vector_norm(
+                      a, ord=p,
+                      axis=tuple(axis) if isinstance(axis, (list, tuple))
+                      else axis, keepdims=keepdim), x)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return run_op("matrix_norm",
+                  lambda a: jnp.linalg.matrix_norm(a, ord=p, keepdims=keepdim),
+                  x)
+
+
+def dist(x, y, p=2, name=None):
+    return run_op("dist",
+                  lambda a, b: jnp.linalg.norm((a - b).reshape(-1), ord=p),
+                  x, y)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    def f(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, -1) + 1e-30)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(diff), p), -1), 1.0 / p)
+    return run_op("cdist", f, x, y)
+
+
+def t(x, name=None):
+    if x.ndim > 2:
+        raise ValueError("paddle.t only supports ndim <= 2")
+    return run_op("t", lambda a: a.T, x)
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+    return run_op("cholesky", f, x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, l):
+        return jax.scipy.linalg.cho_solve((l, not upper), b)
+    return run_op("cholesky_solve", f, x, y)
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    def f(l):
+        eye = jnp.eye(l.shape[-1], dtype=l.dtype)
+        return jax.scipy.linalg.cho_solve((l, not upper), eye)
+    return run_op("cholesky_inverse", f, x)
+
+
+def inverse(x, name=None):
+    return run_op("inverse", jnp.linalg.inv, x)
+
+
+inv = inverse
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return run_op("pinv",
+                  lambda a: jnp.linalg.pinv(a, rtol=rcond,
+                                            hermitian=hermitian), x)
+
+
+def solve(x, y, name=None):
+    def f(a, b):
+        if b.ndim == a.ndim - 1:
+            return jnp.linalg.solve(a, b[..., None])[..., 0]
+        return jnp.linalg.solve(a, b)
+    return run_op("solve", f, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return run_op("triangular_solve", f, x, y)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def f(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+    outs = run_op("lstsq", f, x, y)
+    return outs
+
+
+def qr(x, mode="reduced", name=None):
+    def f(a):
+        return jnp.linalg.qr(a, mode=mode)
+    if mode == "r":
+        return run_op("qr_r", lambda a: jnp.linalg.qr(a, mode="r"), x)
+    return run_op("qr", f, x)
+
+
+def svd(x, full_matrices=False, name=None):
+    return run_op("svd",
+                  lambda a: jnp.linalg.svd(a, full_matrices=full_matrices),
+                  x)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    def f(a):
+        u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+        k = min(q, s.shape[-1])
+        return u[..., :k], s[..., :k], jnp.swapaxes(vt[..., :k, :], -1, -2)
+    return run_op("svd_lowrank", f, x)
+
+
+def eig(x, name=None):
+    # general eig has no XLA lowering on TPU; run on host like the reference
+    # runs LAPACK on CPU
+    arr = np.asarray(x._data)
+    w, v = np.linalg.eig(arr)
+    return Tensor._wrap(jnp.asarray(w)), Tensor._wrap(jnp.asarray(v))
+
+
+def eigvals(x, name=None):
+    arr = np.asarray(x._data)
+    return Tensor._wrap(jnp.asarray(np.linalg.eigvals(arr)))
+
+
+def eigh(x, UPLO="L", name=None):
+    return run_op("eigh",
+                  lambda a: jnp.linalg.eigh(a, UPLO=UPLO), x)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return run_op("eigvalsh",
+                  lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x)
+
+
+def matrix_power(x, n, name=None):
+    return run_op("matrix_power",
+                  lambda a: jnp.linalg.matrix_power(a, n), x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return run_op("matrix_rank",
+                  lambda a: jnp.linalg.matrix_rank(a, rtol=tol),
+                  x, differentiable=False)
+
+
+def det(x, name=None):
+    return run_op("det", jnp.linalg.det, x)
+
+
+def slogdet(x, name=None):
+    def f(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet], 0) if sign.ndim == 0 \
+            else jnp.stack([sign, logdet], 0)
+    return run_op("slogdet", f, x)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def f(a):
+        lu_, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_, (piv + 1).astype(jnp.int32)
+    outs = run_op("lu", f, x)
+    if get_infos:
+        info = Tensor._wrap(jnp.zeros((), jnp.int32))
+        return outs[0], outs[1], info
+    return outs
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    def f(lu_, piv):
+        n = lu_.shape[-2]
+        l = jnp.tril(lu_, -1) + jnp.eye(n, lu_.shape[-1], dtype=lu_.dtype)
+        u = jnp.triu(lu_)
+        perm = jnp.arange(n)
+        def body(i, p):
+            j = piv[i] - 1
+            pi, pj = p[i], p[j]
+            return p.at[i].set(pj).at[j].set(pi)
+        perm = jax.lax.fori_loop(0, piv.shape[-1], body, perm)
+        pmat = jax.nn.one_hot(perm, n, dtype=lu_.dtype).T
+        return pmat, l[..., :n, :min(n, lu_.shape[-1])], u
+    return run_op("lu_unpack", f, x, y)
+
+
+def cond(x, p=None, name=None):
+    return run_op("cond", lambda a: jnp.linalg.cond(a, p=p), x,
+                  differentiable=False)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    def f(a):
+        return jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0)
+    return run_op("cov", f, x)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return run_op("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), x)
+
+
+def householder_product(x, tau, name=None):
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        def one(av, tv):
+            q = jnp.eye(m, dtype=av.dtype)
+            for i in range(n):
+                v = jnp.concatenate([jnp.zeros(i, av.dtype),
+                                     jnp.ones(1, av.dtype), av[i + 1:, i]])
+                q = q - tv[i] * (q @ jnp.outer(v, v))
+            return q[:, :n]
+        if a.ndim == 2:
+            return one(a, t)
+        flat_a = a.reshape((-1,) + a.shape[-2:])
+        flat_t = t.reshape((-1, t.shape[-1]))
+        out = jax.vmap(one)(flat_a, flat_t)
+        return out.reshape(a.shape[:-2] + out.shape[-2:])
+    return run_op("householder_product", f, x, tau)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    def f(a):
+        m, n = a.shape[-2:]
+        k = q if q is not None else min(6, m, n)
+        b = a - jnp.mean(a, axis=-2, keepdims=True) if center else a
+        u, s, vt = jnp.linalg.svd(b, full_matrices=False)
+        return u[..., :k], s[..., :k], jnp.swapaxes(vt[..., :k, :], -1, -2)
+    return run_op("pca_lowrank", f, x)
+
+
+def einsum(equation, *operands):
+    ops_list = list(operands[0]) if len(operands) == 1 and \
+        isinstance(operands[0], (list, tuple)) else list(operands)
+    return run_op("einsum",
+                  lambda *xs: jnp.einsum(equation, *xs), *ops_list)
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    def f(a, t, other):
+        q = None
+        m = a.shape[-2]
+        n = a.shape[-1]
+        qfull = jnp.eye(m, dtype=a.dtype)
+        for i in range(n):
+            v = jnp.concatenate([jnp.zeros(i, a.dtype),
+                                 jnp.ones(1, a.dtype), a[i + 1:, i]])
+            qfull = qfull - t[i] * (qfull @ jnp.outer(v, v))
+        q = qfull
+        if transpose:
+            q = q.T
+        return q @ other if left else other @ q
+    return run_op("ormqr", f, x, tau, y)

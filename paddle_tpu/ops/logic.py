@@ -1,0 +1,57 @@
+"""Comparison / logical / bitwise ops (reference:
+python/paddle/tensor/logic.py over phi compare/logical/bitwise kernels)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import run_op, run_op_inplace
+from paddle_tpu.core.tensor import Tensor
+from .math import _promote_binary
+
+
+def _cmp(name, f):
+    def op(x, y, name=None):
+        x, y = _promote_binary(x, y)
+        return run_op(name, f, x, y, differentiable=False)
+    op.__name__ = name
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+
+
+def logical_not(x, name=None):
+    return run_op("logical_not", jnp.logical_not, x, differentiable=False)
+
+
+def bitwise_not(x, name=None):
+    return run_op("bitwise_not", jnp.bitwise_not, x, differentiable=False)
+
+
+def bitwise_left_shift(x, y, is_arithmetic=True, name=None):
+    return _cmp("bitwise_left_shift", jnp.left_shift)(x, y)
+
+
+def bitwise_right_shift(x, y, is_arithmetic=True, name=None):
+    return _cmp("bitwise_right_shift", jnp.right_shift)(x, y)
+
+
+def is_empty(x, name=None):
+    return Tensor._wrap(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

@@ -1,0 +1,622 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/
+manipulation.py; `view:`-annotated stride kernels phi/kernels/stride/ —
+on TPU every reshape/slice is an XLA view-or-copy decided by the compiler,
+so the stride-kernel machinery collapses into plain lax ops)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import dtype as dtype_mod
+from paddle_tpu.core.dispatch import run_op, run_op_inplace
+from paddle_tpu.core.tensor import Tensor
+
+
+_pyslice = slice  # captured before `def slice(...)` below shadows it
+
+
+def _ints(seq):
+    if isinstance(seq, Tensor):
+        seq = seq.tolist()
+    if isinstance(seq, (int, np.integer)):
+        return int(seq)
+    return [int(s._data if isinstance(s, Tensor) else s) for s in seq]
+
+
+def cast(x, dtype):
+    d = dtype_mod.convert_dtype(dtype)
+    if x.dtype == d:
+        return x
+    if dtype_mod.is_floating_point(x.dtype) and (
+            dtype_mod.is_floating_point(d) or dtype_mod.is_complex(d)):
+        return run_op("cast", lambda a: a.astype(d), x)
+    return run_op("cast", lambda a: a.astype(d), x, differentiable=False)
+
+
+def cast_(x, dtype):
+    d = dtype_mod.convert_dtype(dtype)
+    x._assign_array(x._data.astype(d))
+    return x
+
+
+def reshape(x, shape, name=None):
+    shape = _ints(shape)
+    return run_op("reshape", lambda a: jnp.reshape(a, shape), x)
+
+
+def reshape_(x, shape, name=None):
+    shape = _ints(shape)
+    return run_op_inplace("reshape_", lambda a: jnp.reshape(a, shape), x)
+
+
+view = reshape
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+    def f(a):
+        if a.ndim == 0:
+            return a.reshape(1)
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return a.reshape(new_shape)
+    return run_op("flatten", f, x)
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    out = flatten(x, start_axis, stop_axis)
+    x._assign_array(out._data)
+    x._grad_node, x._out_idx = out._grad_node, out._out_idx
+    return x
+
+
+def transpose(x, perm, name=None):
+    perm = _ints(perm)
+    return run_op("transpose", lambda a: jnp.transpose(a, perm), x)
+
+
+def t(x, name=None):
+    return run_op("t", lambda a: a.T, x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return run_op("moveaxis",
+                  lambda a: jnp.moveaxis(a, _ints(source), _ints(destination)),
+                  x)
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return run_op("swapaxes",
+                  lambda a: jnp.swapaxes(a, int(axis1), int(axis2)), x)
+
+
+transpose_ = None  # paddle has no transpose_
+
+
+def squeeze(x, axis=None, name=None):
+    def f(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(ax % a.ndim for ax in _ints(axes)
+                     if a.shape[ax % a.ndim] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+    return run_op("squeeze", f, x)
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    x._assign_array(out._data)
+    x._grad_node, x._out_idx = out._grad_node, out._out_idx
+    return x
+
+
+def unsqueeze(x, axis, name=None):
+    axes = _ints(axis if isinstance(axis, (list, tuple, Tensor)) else [axis])
+    if isinstance(axes, int):
+        axes = [axes]
+    def f(a):
+        out = a
+        for ax in axes:
+            out = jnp.expand_dims(out, ax)
+        return out
+    return run_op("unsqueeze", f, x)
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    x._assign_array(out._data)
+    x._grad_node, x._out_idx = out._grad_node, out._out_idx
+    return x
+
+
+def concat(x, axis=0, name=None):
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    tensors = list(x)
+    return run_op("concat", lambda *xs: jnp.concatenate(xs, axis=axis),
+                  *tensors)
+
+
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return run_op("stack", lambda *xs: jnp.stack(xs, axis=axis), *tensors)
+
+
+def hstack(x, name=None):
+    return run_op("hstack", lambda *xs: jnp.hstack(xs), *list(x))
+
+
+def vstack(x, name=None):
+    return run_op("vstack", lambda *xs: jnp.vstack(xs), *list(x))
+
+
+def dstack(x, name=None):
+    return run_op("dstack", lambda *xs: jnp.dstack(xs), *list(x))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    n = x.shape[axis % x.ndim]
+    if isinstance(num_or_sections, int):
+        sizes = [n // num_or_sections] * num_or_sections
+    else:
+        sizes = _ints(num_or_sections)
+        total = sum(s for s in sizes if s > 0)
+        sizes = [s if s > 0 else n - total for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+    def f(a):
+        return tuple(
+            jax.lax.slice_in_dim(a, off, off + sz, axis=axis % a.ndim)
+            for off, sz in zip(offsets, sizes))
+    return list(run_op("split", f, x))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    axis = int(axis)
+    n = x.shape[axis % x.ndim]
+    if isinstance(num_or_indices, int):
+        k = num_or_indices
+        base, rem = divmod(n, k)
+        sizes = [base + (1 if i < rem else 0) for i in range(k)]
+        return split(x, sizes, axis)
+    idx = [0] + _ints(num_or_indices) + [n]
+    sizes = [idx[i + 1] - idx[i] for i in range(len(idx) - 1)]
+    return split(x, sizes, axis)
+
+
+def unbind(x, axis=0, name=None):
+    n = x.shape[axis % x.ndim]
+    def f(a):
+        return tuple(jnp.squeeze(s, axis % a.ndim) for s in
+                     jnp.split(a, n, axis=axis % a.ndim))
+    return list(run_op("unbind", f, x))
+
+
+unstack = unbind
+
+
+def expand(x, shape, name=None):
+    shape = _ints(shape)
+    def f(a):
+        tgt = list(shape)
+        nd = len(tgt)
+        src = (1,) * (nd - a.ndim) + a.shape
+        for i in range(nd):
+            if tgt[i] == -1:
+                tgt[i] = src[i]
+        return jnp.broadcast_to(a.reshape(src), tgt)
+    return run_op("expand", f, x)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    outs = run_op("broadcast_tensors",
+                  lambda *xs: tuple(jnp.broadcast_arrays(*xs)), *list(inputs))
+    return list(outs)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def tile(x, repeat_times, name=None):
+    reps = _ints(repeat_times)
+    return run_op("tile", lambda a: jnp.tile(a, reps), x)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        return run_op("repeat_interleave",
+                      lambda a, r: jnp.repeat(
+                          a, r, axis=axis,
+                          total_repeat_length=int(np.asarray(repeats._data).sum())),
+                      x, repeats)
+    return run_op("repeat_interleave",
+                  lambda a: jnp.repeat(a, int(repeats), axis=axis), x)
+
+
+def flip(x, axis, name=None):
+    axes = _ints(axis if isinstance(axis, (list, tuple)) else [axis])
+    return run_op("flip", lambda a: jnp.flip(a, axes), x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return run_op("rot90", lambda a: jnp.rot90(a, k, axes), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = _ints(shifts) if isinstance(shifts, (list, tuple, Tensor)) \
+        else int(shifts)
+    ax = _ints(axis) if isinstance(axis, (list, tuple)) else axis
+    return run_op("roll", lambda a: jnp.roll(a, sh, ax), x)
+
+
+def gather(x, index, axis=0, name=None):
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return run_op("gather",
+                  lambda a, i: jnp.take(a, i.astype(jnp.int32), axis=axis),
+                  x, index)
+
+
+def gather_nd(x, index, name=None):
+    def f(a, idx):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        flat_idx = tuple(jnp.moveaxis(idx, -1, 0))
+        return a[flat_idx]
+    return run_op("gather_nd", f, x, index)
+
+
+def take(x, index, mode="raise", name=None):
+    m = {"raise": "clip", "clip": "clip", "wrap": "wrap"}[mode]
+    return run_op("take",
+                  lambda a, i: jnp.take(a.reshape(-1), i, mode=m),
+                  x, index)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return run_op("take_along_axis",
+                  lambda a, i: jnp.take_along_axis(
+                      a, i.astype(jnp.int32), axis=axis),
+                  arr, indices)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    def f(a, idx, v):
+        idx = idx.astype(jnp.int32)
+        v = jnp.broadcast_to(v, idx.shape).astype(a.dtype)
+        dims = [jax.lax.broadcasted_iota(jnp.int32, idx.shape, d)
+                for d in range(a.ndim)]
+        dims[axis] = idx
+        loc = tuple(dims)
+        if reduce == "assign":
+            return a.at[loc].set(v)
+        if reduce in ("add", "sum"):
+            return a.at[loc].add(v)
+        if reduce in ("mul", "multiply"):
+            return a.at[loc].multiply(v)
+        if reduce == "amax":
+            return a.at[loc].max(v)
+        if reduce == "amin":
+            return a.at[loc].min(v)
+        raise ValueError(f"unknown reduce {reduce}")
+    return run_op("put_along_axis", f, arr, indices, values)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(a, idx, upd):
+        idx = idx.reshape(-1).astype(jnp.int32)
+        if overwrite:
+            return a.at[idx].set(upd.astype(a.dtype))
+        base = a.at[idx].set(jnp.zeros_like(upd, a.dtype))
+        return base.at[idx].add(upd.astype(a.dtype))
+    return run_op("scatter", f, x, index, updates)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    x._assign_array(out._data)
+    x._grad_node, x._out_idx = out._grad_node, out._out_idx
+    return x
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(a, idx, upd):
+        idx = idx.astype(jnp.int32)
+        loc = tuple(jnp.moveaxis(idx, -1, 0))
+        return a.at[loc].add(upd.astype(a.dtype))
+    return run_op("scatter_nd_add", f, x, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    def f(idx, upd):
+        a = jnp.zeros(_ints(shape), upd.dtype)
+        loc = tuple(jnp.moveaxis(idx.astype(jnp.int32), -1, 0))
+        return a.at[loc].add(upd)
+    return run_op("scatter_nd", f, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+def index_sample(x, index, name=None):
+    def f(a, idx):
+        return jnp.take_along_axis(a, idx.astype(jnp.int32), axis=1)
+    return run_op("index_sample", f, x, index)
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(a, idx, v):
+        idx = idx.astype(jnp.int32)
+        a_m = jnp.moveaxis(a, axis, 0)
+        v_m = jnp.moveaxis(v.astype(a.dtype), axis, 0)
+        out = a_m.at[idx].add(v_m)
+        return jnp.moveaxis(out, 0, axis)
+    return run_op("index_add", f, x, index, value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx_tensors = list(indices)
+    def f(a, v, *idxs):
+        loc = tuple(i.astype(jnp.int32) if jnp.issubdtype(i.dtype, jnp.integer)
+                    else i for i in idxs)
+        if accumulate:
+            return a.at[loc].add(v.astype(a.dtype))
+        return a.at[loc].set(v.astype(a.dtype))
+    return run_op("index_put", f, x, value, *idx_tensors)
+
+
+def index_fill(x, index, axis, value, name=None):
+    def f(a, idx):
+        a_m = jnp.moveaxis(a, axis, 0)
+        out = a_m.at[idx.astype(jnp.int32)].set(
+            jnp.asarray(value, a.dtype))
+        return jnp.moveaxis(out, 0, axis)
+    return run_op("index_fill", f, x, index)
+
+
+def masked_select(x, mask, name=None):
+    # dynamic output shape — host-side (not jittable), like reference's
+    # masked_select which is inherently dynamic
+    data = np.asarray(x._data)
+    m = np.asarray(mask._data)
+    return Tensor._wrap(jnp.asarray(data[m]))
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value._data if isinstance(value, Tensor) else value
+    if isinstance(value, Tensor):
+        return run_op("masked_fill",
+                      lambda a, m, vv: jnp.where(m, vv.astype(a.dtype), a),
+                      x, mask, value)
+    return run_op("masked_fill",
+                  lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a),
+                  x, mask)
+
+
+def masked_fill_(x, mask, value, name=None):
+    out = masked_fill(x, mask, value)
+    x._assign_array(out._data)
+    x._grad_node, x._out_idx = out._grad_node, out._out_idx
+    return x
+
+
+def masked_scatter(x, mask, value, name=None):
+    data = np.asarray(x._data).copy()
+    m = np.asarray(mask._data)
+    v = np.asarray(value._data).reshape(-1)
+    data[m] = v[: int(m.sum())]
+    return Tensor._wrap(jnp.asarray(data))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        from .search import nonzero
+        return nonzero(condition, as_tuple=True)
+    from .math import _promote_binary
+    x, y = _promote_binary(x, y)
+    return run_op("where", lambda c, a, b: jnp.where(c, a, b),
+                  condition, x, y)
+
+
+def where_(condition, x, y, name=None):
+    out = where(condition, x, y)
+    x._assign_array(out._data)
+    x._grad_node, x._out_idx = out._grad_node, out._out_idx
+    return x
+
+
+def numel(x, name=None):
+    return Tensor._wrap(jnp.asarray(x.size, jnp.int64))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    size = index_num // nshards
+    def f(a):
+        lo, hi = shard_id * size, (shard_id + 1) * size
+        inside = (a >= lo) & (a < hi)
+        return jnp.where(inside, a - lo, ignore_value)
+    return run_op("shard_index", f, input, differentiable=False)
+
+
+def slice(input, axes, starts, ends, name=None):
+    axes = _ints(axes)
+    starts = _ints(starts)
+    ends = _ints(ends)
+    def f(a):
+        out = a
+        for ax, st, en in zip(axes, starts, ends):
+            n = a.shape[ax]
+            st2 = max(st + n, 0) if st < 0 else min(st, n)
+            en2 = max(en + n, 0) if en < 0 else min(en, n)
+            out = jax.lax.slice_in_dim(out, st2, en2, axis=ax)
+        return out
+    return run_op("slice", f, input)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    axes, starts, ends, strides = map(_ints, (axes, starts, ends, strides))
+    def f(a):
+        idx = [_pyslice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax] = _pyslice(st, en, sd)
+        return a[tuple(idx)]
+    return run_op("strided_slice", f, x)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shape = _ints(shape)
+    offsets = _ints(offsets) if offsets is not None else [0] * x.ndim
+    def f(a):
+        sizes = [s if s != -1 else a.shape[i] - offsets[i]
+                 for i, s in enumerate(shape)]
+        return jax.lax.dynamic_slice(a, offsets, sizes)
+    return run_op("crop", f, x)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    pad = _ints(pad)
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle F.pad semantics: pad applies to last len(pad)//2 spatial dims
+        # in (NCHW/NHWC) layout, given reversed like torch
+        k = len(pad) // 2
+        cfg = [(0, 0)] * nd
+        if data_format.endswith("C"):  # NHWC/NDHWC: spatial dims 1..nd-2
+            dims = range(1, 1 + k)
+        else:
+            dims = range(nd - k, nd)
+        for i, d in enumerate(dims):
+            cfg[d] = (pad[2 * i], pad[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    def f(a):
+        if jmode == "constant":
+            return jnp.pad(a, cfg, mode="constant", constant_values=value)
+        return jnp.pad(a, cfg, mode=jmode)
+    return run_op("pad", f, x)
+
+
+def unfold(x, kernel_size, strides=1, paddings=0, dilations=1, name=None):
+    ks = _ints(kernel_size) if isinstance(kernel_size, (list, tuple)) \
+        else [kernel_size] * 2
+    st = _ints(strides) if isinstance(strides, (list, tuple)) \
+        else [strides] * 2
+    pd = _ints(paddings) if isinstance(paddings, (list, tuple)) \
+        else [paddings] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+    dl = _ints(dilations) if isinstance(dilations, (list, tuple)) \
+        else [dilations] * 2
+    def f(a):
+        n, c, h, w = a.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            a, ks, st, [(pd[0], pd[2]), (pd[1], pd[3])],
+            rhs_dilation=dl, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return patches.reshape(n, patches.shape[1], -1)
+    return run_op("unfold", f, x)
+
+
+def as_complex(x, name=None):
+    return run_op("as_complex",
+                  lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x)
+
+
+def as_real(x, name=None):
+    return run_op("as_real",
+                  lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], -1), x)
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, Tensor):
+        axes = axes.tolist()
+    return run_op("tensordot", lambda a, b: jnp.tensordot(a, b, axes), x, y)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [run_op("atleast_1d", jnp.atleast_1d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [run_op("atleast_2d", jnp.atleast_2d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [run_op("atleast_3d", jnp.atleast_3d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+# ------------------------- __getitem__ / __setitem__ -----------------------
+def _convert_index(item):
+    """Convert a python index spec (possibly containing Tensors) into
+    (static_part, tensor_list, rebuild)."""
+    if not isinstance(item, tuple):
+        item = (item,)
+    tensors = []
+    spec = []
+    for it in item:
+        if isinstance(it, Tensor):
+            spec.append(("T", len(tensors)))
+            tensors.append(it)
+        else:
+            spec.append(("S", it))
+        # bool list / ndarray handled by jnp directly
+    def rebuild(arrays):
+        out = []
+        for kind, v in spec:
+            if kind == "T":
+                a = arrays[v]
+                if jnp.issubdtype(a.dtype, jnp.integer):
+                    a = a.astype(jnp.int32)
+                out.append(a)
+            else:
+                out.append(v)
+        return tuple(out)
+    return tensors, rebuild
+
+
+def getitem(x, item):
+    tensors, rebuild = _convert_index(item)
+    def f(a, *idx_arrays):
+        return a[rebuild(idx_arrays)]
+    return run_op("getitem", f, x, *tensors)
+
+
+def setitem(x, item, value):
+    tensors, rebuild = _convert_index(item)
+    if isinstance(value, Tensor):
+        def f(a, v, *idx_arrays):
+            return a.at[rebuild(idx_arrays)].set(v.astype(a.dtype))
+        out = run_op("setitem", f, x, value, *tensors)
+    else:
+        def f(a, *idx_arrays):
+            return a.at[rebuild(idx_arrays)].set(
+                jnp.asarray(value, a.dtype))
+        out = run_op("setitem", f, x, *tensors)
+    x._assign_array(out._data)
+    x._grad_node, x._out_idx = out._grad_node, out._out_idx
+    x.stop_gradient = out.stop_gradient and x.stop_gradient
+    return x

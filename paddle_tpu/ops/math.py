@@ -1,0 +1,522 @@
+"""Elementwise + reduction math ops (reference: python/paddle/tensor/math.py
+over phi kernels; kernels listed in paddle/phi/ops/yaml/ops.yaml).
+
+Each op is one XLA-traceable jnp function dispatched through run_op, which
+handles AMP, autograd recording (jax.vjp) and NaN checking.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import dtype as dtype_mod
+from paddle_tpu.core.dispatch import run_op, run_op_inplace
+from paddle_tpu.core.tensor import Tensor
+
+
+def _promote_binary(x, y):
+    """Paddle binary promotion: tensor-scalar keeps tensor dtype (for weak
+    python scalars); tensor-tensor promotes via the lattice."""
+    if isinstance(x, Tensor) and not isinstance(y, Tensor):
+        if isinstance(y, bool):
+            y = Tensor._wrap(jnp.asarray(y))
+        elif isinstance(y, (int, float)):
+            dt = x.dtype
+            if isinstance(y, float) and dtype_mod.is_integer(dt):
+                dt = dtype_mod.get_default_dtype()
+            y = Tensor._wrap(jnp.asarray(y, dt))
+        else:
+            y = Tensor._wrap(jnp.asarray(y))
+    elif isinstance(y, Tensor) and not isinstance(x, Tensor):
+        if isinstance(x, (int, float)) and not isinstance(x, bool):
+            dt = y.dtype
+            if isinstance(x, float) and dtype_mod.is_integer(dt):
+                dt = dtype_mod.get_default_dtype()
+            x = Tensor._wrap(jnp.asarray(x, dt))
+        else:
+            x = Tensor._wrap(jnp.asarray(x))
+    if isinstance(x, Tensor) and isinstance(y, Tensor) and x.dtype != y.dtype:
+        d = dtype_mod.promote_types(x.dtype, y.dtype)
+        if x.dtype != d:
+            x = Tensor._wrap(x._data.astype(d), x.stop_gradient)
+            x._grad_node = None  # cast outside tape is fine: promotion of
+            # a differentiable input goes through cast op below instead
+        if y.dtype != d:
+            y = Tensor._wrap(y._data.astype(d), y.stop_gradient)
+            y._grad_node = None
+    return x, y
+
+
+def _binop(name, f):
+    def op(x, y, name=None):
+        from paddle_tpu.ops.manipulation import cast
+        if isinstance(x, Tensor) and isinstance(y, Tensor) \
+                and x.dtype != y.dtype:
+            d = dtype_mod.promote_types(x.dtype, y.dtype)
+            x = cast(x, d) if x.dtype != d else x
+            y = cast(y, d) if y.dtype != d else y
+        else:
+            x, y = _promote_binary(x, y)
+        return run_op(name, f, x, y)
+    op.__name__ = name
+    return op
+
+
+add = _binop("add", jnp.add)
+subtract = _binop("subtract", jnp.subtract)
+multiply = _binop("multiply", jnp.multiply)
+divide = _binop("divide", lambda a, b: jnp.true_divide(a, b)
+                if not jnp.issubdtype(a.dtype, jnp.integer)
+                else jnp.true_divide(a, b))
+floor_divide = _binop("floor_divide", jnp.floor_divide)
+remainder = _binop("remainder", jnp.remainder)
+mod = remainder
+floor_mod = remainder
+fmod = _binop("fmod", jnp.fmod)
+maximum = _binop("maximum", jnp.maximum)
+minimum = _binop("minimum", jnp.minimum)
+fmax = _binop("fmax", jnp.fmax)
+fmin = _binop("fmin", jnp.fmin)
+atan2 = _binop("atan2", jnp.arctan2)
+hypot = _binop("hypot", lambda a, b: jnp.sqrt(a * a + b * b))
+heaviside = _binop("heaviside", jnp.heaviside)
+gcd = _binop("gcd", jnp.gcd)
+lcm = _binop("lcm", jnp.lcm)
+nextafter = _binop("nextafter", jnp.nextafter)
+copysign = _binop("copysign", jnp.copysign)
+logaddexp = _binop("logaddexp", jnp.logaddexp)
+
+
+def pow(x, y, name=None):
+    if isinstance(y, (int, float)) and not isinstance(y, bool):
+        return run_op("pow", lambda a: jnp.power(a, y), x)
+    return _binop("elementwise_pow", jnp.power)(x, y)
+
+
+def _unary(name, f):
+    def op(x, name=None):
+        return op_impl(x)
+    def op_impl(x):
+        return run_op(name, f, x)
+    op.__name__ = name
+    return op
+
+
+def _float_unary(name, f):
+    """Unary op that promotes int inputs to the default float dtype (paddle
+    activation-op semantics)."""
+    def op(x, name=None):
+        if isinstance(x, Tensor) and dtype_mod.is_integer(x.dtype):
+            x = Tensor._wrap(
+                x._data.astype(dtype_mod.get_default_dtype()))
+        return run_op(name, f, x)
+    op.__name__ = name
+    return op
+
+
+exp = _float_unary("exp", jnp.exp)
+expm1 = _float_unary("expm1", jnp.expm1)
+log = _float_unary("log", jnp.log)
+log2 = _float_unary("log2", jnp.log2)
+log10 = _float_unary("log10", jnp.log10)
+log1p = _float_unary("log1p", jnp.log1p)
+sqrt = _float_unary("sqrt", jnp.sqrt)
+rsqrt = _float_unary("rsqrt", jax.lax.rsqrt)
+square = _unary("square", jnp.square)
+abs = _unary("abs", jnp.abs)
+sign = _unary("sign", jnp.sign)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+round = _unary("round", jnp.round)
+trunc = _unary("trunc", jnp.trunc)
+frac = _unary("frac", lambda a: a - jnp.trunc(a))
+sin = _float_unary("sin", jnp.sin)
+cos = _float_unary("cos", jnp.cos)
+tan = _float_unary("tan", jnp.tan)
+asin = _float_unary("asin", jnp.arcsin)
+acos = _float_unary("acos", jnp.arccos)
+atan = _float_unary("atan", jnp.arctan)
+sinh = _float_unary("sinh", jnp.sinh)
+cosh = _float_unary("cosh", jnp.cosh)
+tanh = _float_unary("tanh", jnp.tanh)
+asinh = _float_unary("asinh", jnp.arcsinh)
+acosh = _float_unary("acosh", jnp.arccosh)
+atanh = _float_unary("atanh", jnp.arctanh)
+reciprocal = _float_unary("reciprocal", lambda a: 1.0 / a)
+sigmoid = _float_unary("sigmoid", jax.nn.sigmoid)
+logit = _float_unary("logit", lambda a: jnp.log(a / (1 - a)))
+erf = _float_unary("erf", jax.lax.erf)
+erfinv = _float_unary("erfinv", jax.lax.erf_inv)
+lgamma = _float_unary("lgamma", jax.lax.lgamma)
+digamma = _float_unary("digamma", jax.lax.digamma)
+i0 = _float_unary("i0", lambda a: jax.lax.bessel_i0e(a) * jnp.exp(jnp.abs(a)))
+i1 = _float_unary("i1", lambda a: jax.lax.bessel_i1e(a) * jnp.exp(jnp.abs(a)))
+i0e = _float_unary("i0e", jax.lax.bessel_i0e)
+i1e = _float_unary("i1e", jax.lax.bessel_i1e)
+neg = _unary("neg", jnp.negative)
+conj = _unary("conj", jnp.conj)
+angle = _unary("angle", jnp.angle)
+deg2rad = _float_unary("deg2rad", jnp.deg2rad)
+rad2deg = _float_unary("rad2deg", jnp.rad2deg)
+exponent = None  # not part of paddle API
+
+
+def real(x, name=None):
+    return run_op("real", jnp.real, x)
+
+
+def imag(x, name=None):
+    return run_op("imag", jnp.imag, x)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return run_op("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), x)
+
+
+def multiplex(inputs, index, name=None):
+    def f(idx, *xs):
+        stacked = jnp.stack(xs, axis=0)
+        return jnp.take_along_axis(
+            stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))),
+            axis=0)[0]
+    return run_op("multiplex", lambda idx, *xs: f(idx, *xs),
+                  index, *inputs)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = scale.item() if isinstance(scale, Tensor) else scale
+    def f(a):
+        out = a * jnp.asarray(s, a.dtype) + jnp.asarray(bias, a.dtype) \
+            if bias_after_scale else (a + jnp.asarray(bias, a.dtype)) * \
+            jnp.asarray(s, a.dtype)
+        return out
+    return run_op("scale", f, x)
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return run_op("clip", lambda a: jnp.clip(a, lo, hi), x)
+
+
+def clip_(x, min=None, max=None, name=None):
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return run_op_inplace("clip_", lambda a: jnp.clip(a, lo, hi), x)
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return run_op("lerp", lambda a, b, w: a + w * (b - a), x, y, weight)
+    return run_op("lerp", lambda a, b: a + weight * (b - a), x, y)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return run_op("nan_to_num",
+                  lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf,
+                                           neginf=neginf), x)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return run_op("addmm",
+                  lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+                  input, x, y)
+
+
+def inner(x, y, name=None):
+    return run_op("inner", jnp.inner, x, y)
+
+
+def outer(x, y, name=None):
+    return run_op("outer", lambda a, b: jnp.outer(a, b), x, y)
+
+
+def kron(x, y, name=None):
+    return run_op("kron", jnp.kron, x, y)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return run_op("trace",
+                  lambda a: jnp.trace(a, offset, axis1, axis2), x)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return run_op("diagonal",
+                  lambda a: jnp.diagonal(a, offset, axis1, axis2), x)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    ins = [x]
+    has_p = prepend is not None
+    has_a = append is not None
+    if has_p:
+        ins.append(prepend)
+    if has_a:
+        ins.append(append)
+    def f(a, *rest):
+        i = 0
+        p = rest[i] if has_p else None
+        i += has_p
+        ap = rest[i] if has_a else None
+        return jnp.diff(a, n=n, axis=axis, prepend=p, append=ap)
+    return run_op("diff", f, *ins)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    d = dtype_mod.convert_dtype(dtype)
+    return run_op("cumsum", lambda a: jnp.cumsum(a, axis=axis, dtype=d), x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    d = dtype_mod.convert_dtype(dtype)
+    return run_op("cumprod", lambda a: jnp.cumprod(a, axis=dim, dtype=d), x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        if axis is None:
+            a2 = a.reshape(-1)
+            ax = 0
+        else:
+            a2, ax = a, axis
+        vals = jax.lax.associative_scan(jnp.maximum, a2, axis=ax)
+        n = a2.shape[ax]
+        iota = jax.lax.broadcasted_iota(jnp.int64 if dtype == "int64"
+                                        else jnp.int32, a2.shape, ax)
+        eq = a2 == vals
+        idx = jnp.where(eq, iota, 0)
+        idx = jax.lax.associative_scan(jnp.maximum, idx, axis=ax)
+        return vals, idx
+    outs = run_op("cummax", f, x)
+    return outs
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        if axis is None:
+            a2 = a.reshape(-1)
+            ax = 0
+        else:
+            a2, ax = a, axis
+        vals = jax.lax.associative_scan(jnp.minimum, a2, axis=ax)
+        iota = jax.lax.broadcasted_iota(jnp.int64 if dtype == "int64"
+                                        else jnp.int32, a2.shape, ax)
+        eq = a2 == vals
+        idx = jnp.where(eq, iota, 0)
+        idx = jax.lax.associative_scan(jnp.maximum, idx, axis=ax)
+        return vals, idx
+    return run_op("cummin", f, x)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def f(a):
+        ax = axis
+        a2 = a
+        if ax is None:
+            a2 = a.reshape(-1)
+            ax = 0
+        def comb(x1, x2):
+            return jnp.logaddexp(x1, x2)
+        return jax.lax.associative_scan(comb, a2, axis=ax)
+    return run_op("logcumsumexp", f, x)
+
+
+# ------------------------------ reductions ---------------------------------
+def _axis_arg(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    d = dtype_mod.convert_dtype(dtype)
+    def f(a):
+        out_dtype = d
+        if out_dtype is None and jnp.issubdtype(a.dtype, jnp.integer):
+            out_dtype = jnp.int64
+        return jnp.sum(a, axis=ax, dtype=out_dtype, keepdims=keepdim)
+    return run_op("sum", f, x)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    return run_op("mean", lambda a: jnp.mean(a, axis=ax, keepdims=keepdim), x)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    ax = _axis_arg(axis)
+    d = dtype_mod.convert_dtype(dtype)
+    return run_op("prod",
+                  lambda a: jnp.prod(a, axis=ax, dtype=d, keepdims=keepdim), x)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    return run_op("max", lambda a: jnp.max(a, axis=ax, keepdims=keepdim), x)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    return run_op("min", lambda a: jnp.min(a, axis=ax, keepdims=keepdim), x)
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    return run_op("std",
+                  lambda a: jnp.std(a, axis=ax, ddof=1 if unbiased else 0,
+                                    keepdims=keepdim), x)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    return run_op("var",
+                  lambda a: jnp.var(a, axis=ax, ddof=1 if unbiased else 0,
+                                    keepdims=keepdim), x)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    d = dtype_mod.convert_dtype(dtype)
+    return run_op("nansum",
+                  lambda a: jnp.nansum(a, axis=ax, dtype=d, keepdims=keepdim),
+                  x)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    return run_op("nanmean",
+                  lambda a: jnp.nanmean(a, axis=ax, keepdims=keepdim), x)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    return run_op("logsumexp",
+                  lambda a: jax.scipy.special.logsumexp(
+                      a, axis=ax, keepdims=keepdim), x)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    return run_op("all", lambda a: jnp.all(a, axis=ax, keepdims=keepdim), x,
+                  differentiable=False)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    return run_op("any", lambda a: jnp.any(a, axis=ax, keepdims=keepdim), x,
+                  differentiable=False)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    return run_op("count_nonzero",
+                  lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim),
+                  x, differentiable=False)
+
+
+def isnan(x, name=None):
+    return run_op("isnan", jnp.isnan, x, differentiable=False)
+
+
+def isinf(x, name=None):
+    return run_op("isinf", jnp.isinf, x, differentiable=False)
+
+
+def isfinite(x, name=None):
+    return run_op("isfinite", jnp.isfinite, x, differentiable=False)
+
+
+def isneginf(x, name=None):
+    return run_op("isneginf", jnp.isneginf, x, differentiable=False)
+
+
+def isposinf(x, name=None):
+    return run_op("isposinf", jnp.isposinf, x, differentiable=False)
+
+
+def isreal(x, name=None):
+    return run_op("isreal", jnp.isreal, x, differentiable=False)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return run_op("isclose",
+                  lambda a, b: jnp.isclose(a, b, rtol, atol, equal_nan),
+                  x, y, differentiable=False)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return run_op("allclose",
+                  lambda a, b: jnp.allclose(a, b, rtol, atol, equal_nan),
+                  x, y, differentiable=False)
+
+
+def equal_all(x, y, name=None):
+    return run_op("equal_all", lambda a, b: jnp.array_equal(a, b), x, y,
+                  differentiable=False)
+
+
+# -------------------------- inplace variants --------------------------------
+def add_(x, y, name=None):
+    x2, y2 = _promote_binary(x, y)
+    return run_op_inplace("add_", jnp.add, x, y2)
+
+
+def subtract_(x, y, name=None):
+    _, y2 = _promote_binary(x, y)
+    return run_op_inplace("subtract_", jnp.subtract, x, y2)
+
+
+def multiply_(x, y, name=None):
+    _, y2 = _promote_binary(x, y)
+    return run_op_inplace("multiply_", jnp.multiply, x, y2)
+
+
+def divide_(x, y, name=None):
+    _, y2 = _promote_binary(x, y)
+    return run_op_inplace("divide_", jnp.true_divide, x, y2)
+
+
+def scale_(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = scale
+    def f(a):
+        if bias_after_scale:
+            return a * jnp.asarray(s, a.dtype) + jnp.asarray(bias, a.dtype)
+        return (a + jnp.asarray(bias, a.dtype)) * jnp.asarray(s, a.dtype)
+    return run_op_inplace("scale_", f, x)
+
+
+def zero_(x):
+    x._assign_array(jnp.zeros_like(x._data))
+    return x
+
+
+def fill_(x, value):
+    x._assign_array(jnp.full_like(x._data, value))
+    return x
+
+
+def exp_(x, name=None):
+    return run_op_inplace("exp_", jnp.exp, x)
+
+
+def sqrt_(x, name=None):
+    return run_op_inplace("sqrt_", jnp.sqrt, x)
+
+
+def increment(x, value=1.0, name=None):
+    return run_op_inplace("increment",
+                          lambda a: a + jnp.asarray(value, a.dtype), x)

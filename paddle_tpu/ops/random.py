@@ -1,0 +1,176 @@
+"""Random sampling ops (reference: python/paddle/tensor/random.py over phi
+gaussian/uniform kernels + phi/core/generator.h offset discipline).
+
+Every op pulls one fresh key from the default Generator (threefry fold_in,
+see core/generator.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import dtype as dtype_mod
+from paddle_tpu.core import generator as gen_mod
+from paddle_tpu.core.dispatch import run_op
+from paddle_tpu.core.tensor import Tensor
+from .creation import _shape_tuple
+
+
+def _dt(dtype):
+    d = dtype_mod.convert_dtype(dtype)
+    return d if d is not None else dtype_mod.get_default_dtype()
+
+
+def rand(shape, dtype=None, name=None):
+    key = gen_mod.next_key()
+    return Tensor._wrap(
+        jax.random.uniform(key, _shape_tuple(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    key = gen_mod.next_key()
+    return Tensor._wrap(
+        jax.random.normal(key, _shape_tuple(shape), _dt(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    key = gen_mod.next_key()
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else jnp.asarray(mean)
+        s = std._data if isinstance(std, Tensor) else jnp.asarray(std)
+        shp = np.broadcast_shapes(m.shape, s.shape)
+        z = jax.random.normal(key, shp, dtype_mod.get_default_dtype())
+        return Tensor._wrap(m + s * z)
+    shp = _shape_tuple(shape) if shape is not None else ()
+    z = jax.random.normal(key, shp, dtype_mod.get_default_dtype())
+    return Tensor._wrap(mean + std * z)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else gen_mod.next_key()
+    return Tensor._wrap(jax.random.uniform(
+        key, _shape_tuple(shape), _dt(dtype), minval=min, maxval=max))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    key = gen_mod.next_key()
+    return Tensor._wrap(jax.random.randint(
+        key, _shape_tuple(shape), low, high,
+        dtype=dtype_mod.convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    d = dtype_mod.convert_dtype(dtype) or x.dtype
+    if high is None:
+        low, high = 0, low
+    key = gen_mod.next_key()
+    out = jax.random.randint(key, tuple(x.shape), int(low), int(high),
+                             dtype=jnp.int64)
+    return Tensor._wrap(out.astype(d))
+
+
+def randperm(n, dtype="int64", name=None):
+    key = gen_mod.next_key()
+    return Tensor._wrap(jax.random.permutation(key, n).astype(
+        dtype_mod.convert_dtype(dtype)))
+
+
+def bernoulli(x, name=None):
+    key = gen_mod.next_key()
+    def f(a):
+        return jax.random.bernoulli(key, a).astype(a.dtype)
+    return run_op("bernoulli", f, x, differentiable=False)
+
+
+def bernoulli_(x, p=0.5, name=None):
+    key = gen_mod.next_key()
+    x._assign_array(
+        jax.random.bernoulli(key, p, tuple(x.shape)).astype(x._data.dtype))
+    return x
+
+
+def binomial(count, prob, name=None):
+    key = gen_mod.next_key()
+    def f(n, p):
+        return jax.random.binomial(key, n, p).astype(jnp.int64)
+    return run_op("binomial", f, count, prob, differentiable=False)
+
+
+def poisson(x, name=None):
+    key = gen_mod.next_key()
+    def f(lam):
+        return jax.random.poisson(key, lam).astype(lam.dtype)
+    return run_op("poisson", f, x, differentiable=False)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = gen_mod.next_key()
+    def f(p):
+        logits = jnp.log(jnp.maximum(p, 1e-30))
+        if replacement:
+            return jax.random.categorical(
+                key, logits, axis=-1,
+                shape=(num_samples,) + p.shape[:-1]).T \
+                if p.ndim > 1 else jax.random.categorical(
+                    key, logits, shape=(num_samples,))
+        # without replacement: gumbel top-k trick
+        g = jax.random.gumbel(key, p.shape)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx
+    out = run_op("multinomial", f, x, differentiable=False)
+    from paddle_tpu.ops.manipulation import cast
+    return cast(out, "int64")
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else gen_mod.next_key()
+    x._assign_array(jax.random.uniform(
+        key, tuple(x.shape), x._data.dtype, minval=min, maxval=max))
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    key = gen_mod.next_key()
+    x._assign_array(
+        (mean + std * jax.random.normal(key, tuple(x.shape))).astype(
+            x._data.dtype))
+    return x
+
+
+def exponential_(x, lam=1.0, name=None):
+    key = gen_mod.next_key()
+    x._assign_array(
+        (jax.random.exponential(key, tuple(x.shape)) / lam).astype(
+            x._data.dtype))
+    return x
+
+
+def rand_like(x, dtype=None, name=None):
+    key = gen_mod.next_key()
+    d = dtype_mod.convert_dtype(dtype) or x.dtype
+    return Tensor._wrap(jax.random.uniform(key, tuple(x.shape), d))
+
+
+def randn_like(x, dtype=None, name=None):
+    key = gen_mod.next_key()
+    d = dtype_mod.convert_dtype(dtype) or x.dtype
+    return Tensor._wrap(jax.random.normal(key, tuple(x.shape), d))
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    key = jax.random.PRNGKey(seed) if seed else gen_mod.next_key()
+    return Tensor._wrap(
+        mean + std * jax.random.normal(key, _shape_tuple(shape), _dt(dtype)))
+
+
+def laplace(loc=0.0, scale=1.0, shape=None, dtype=None, name=None):
+    key = gen_mod.next_key()
+    shp = _shape_tuple(shape) if shape is not None else ()
+    return Tensor._wrap(
+        loc + scale * jax.random.laplace(key, shp, _dt(dtype)))

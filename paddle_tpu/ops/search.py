@@ -1,0 +1,250 @@
+"""Search / sort ops (reference: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import dtype as dtype_mod
+from paddle_tpu.core.dispatch import run_op
+from paddle_tpu.core.tensor import Tensor
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = dtype_mod.convert_dtype(dtype)
+    def f(a):
+        out = jnp.argmax(a.reshape(-1) if axis is None else a,
+                         axis=0 if axis is None else axis,
+                         keepdims=keepdim and axis is not None)
+        return out.astype(d)
+    return run_op("argmax", f, x, differentiable=False)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = dtype_mod.convert_dtype(dtype)
+    def f(a):
+        out = jnp.argmin(a.reshape(-1) if axis is None else a,
+                         axis=0 if axis is None else axis,
+                         keepdims=keepdim and axis is not None)
+        return out.astype(d)
+    return run_op("argmin", f, x, differentiable=False)
+
+
+def argsort(x, axis=-1, descending=False, stable=True, name=None):
+    def f(a):
+        idx = jnp.argsort(a, axis=axis, stable=stable,
+                          descending=descending)
+        return idx.astype(jnp.int64)
+    return run_op("argsort", f, x, differentiable=False)
+
+
+def sort(x, axis=-1, descending=False, stable=True, name=None):
+    return run_op("sort",
+                  lambda a: jnp.sort(a, axis=axis, stable=stable,
+                                     descending=descending), x)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    k = int(k.item()) if isinstance(k, Tensor) else int(k)
+    def f(a):
+        ax = a.ndim - 1 if axis is None else axis % a.ndim
+        moved = jnp.moveaxis(a, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(moved, k)
+        else:
+            vals, idx = jax.lax.top_k(-moved, k)
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax),
+                jnp.moveaxis(idx.astype(jnp.int64), -1, ax))
+    return run_op("topk", f, x)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        sorted_a = jnp.sort(a, axis=ax)
+        sorted_i = jnp.argsort(a, axis=ax)
+        vals = jnp.take(sorted_a, k - 1, axis=ax)
+        idx = jnp.take(sorted_i, k - 1, axis=ax).astype(jnp.int64)
+        if keepdim:
+            vals = jnp.expand_dims(vals, ax)
+            idx = jnp.expand_dims(idx, ax)
+        return vals, idx
+    return run_op("kthvalue", f, x)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        moved = jnp.moveaxis(a, ax, -1)
+        s = jnp.sort(moved, axis=-1)
+        # mode = value with the longest run in sorted order
+        eq = s[..., 1:] == s[..., :-1]
+        same = jnp.concatenate([jnp.zeros_like(s[..., :1], bool), eq], -1)
+        cnt = np_run_lengths(same)
+        best = jnp.argmax(cnt, axis=-1)
+        vals = jnp.take_along_axis(s, best[..., None], axis=-1)[..., 0]
+        idx = jnp.argmax((moved == vals[..., None]).astype(jnp.int32),
+                         axis=-1).astype(jnp.int64)
+        if keepdim:
+            vals = jnp.expand_dims(vals, -1)
+            idx = jnp.expand_dims(idx, -1)
+            vals = jnp.moveaxis(vals, -1, ax)
+            idx = jnp.moveaxis(idx, -1, ax)
+        return vals, idx
+    return run_op("mode", f, x)
+
+
+def np_run_lengths(same):
+    def step(carry, s):
+        cnt = jnp.where(s, carry + 1, jnp.ones_like(carry))
+        return cnt, cnt
+    moved = jnp.moveaxis(same, -1, 0)
+    init = jnp.zeros(moved.shape[1:], jnp.int32)
+    _, out = jax.lax.scan(step, init, moved)
+    return jnp.moveaxis(out, 0, -1)
+
+
+def nonzero(x, as_tuple=False):
+    arr = np.asarray(x._data)
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor._wrap(jnp.asarray(i, jnp.int64).reshape(-1, 1))
+                     for i in nz)
+    return Tensor._wrap(jnp.asarray(np.stack(nz, -1), jnp.int64))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    side = "right" if right else "left"
+    d = jnp.int32 if out_int32 else jnp.int64
+    def f(seq, v):
+        if seq.ndim == 1:
+            return jnp.searchsorted(seq, v, side=side).astype(d)
+        return jax.vmap(
+            lambda s, vv: jnp.searchsorted(s, vv, side=side))(
+                seq.reshape(-1, seq.shape[-1]),
+                v.reshape(-1, v.shape[-1])).reshape(v.shape).astype(d)
+    return run_op("searchsorted", f, sorted_sequence, values,
+                  differentiable=False)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def f(a):
+        if mode == "avg":
+            return jnp.median(a, axis=axis, keepdims=keepdim)
+        # min mode: lower of the two middles
+        ax = axis if axis is not None else None
+        if ax is None:
+            s = jnp.sort(a.reshape(-1))
+            return s[(s.shape[0] - 1) // 2]
+        s = jnp.sort(a, axis=ax)
+        return jnp.take(s, (s.shape[ax] - 1) // 2, axis=ax)
+    out = run_op("median", f, x)
+    return out
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return run_op("nanmedian",
+                  lambda a: jnp.nanmedian(a, axis=axis, keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear",
+             name=None):
+    qv = q._data if isinstance(q, Tensor) else jnp.asarray(q)
+    return run_op("quantile",
+                  lambda a: jnp.quantile(a.astype(jnp.float64)
+                                         if False else a, qv, axis=axis,
+                                         keepdims=keepdim,
+                                         method=interpolation), x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    qv = q._data if isinstance(q, Tensor) else jnp.asarray(q)
+    return run_op("nanquantile",
+                  lambda a: jnp.nanquantile(a, qv, axis=axis,
+                                            keepdims=keepdim), x)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    arr = np.asarray(x._data)
+    res = np.unique(arr, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not (return_index or return_inverse or return_counts):
+        return Tensor._wrap(jnp.asarray(res))
+    outs = [Tensor._wrap(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    arr = np.asarray(x._data)
+    if axis is None:
+        arr = arr.reshape(-1)
+        ax = 0
+    else:
+        ax = axis
+    take = np.ones(arr.shape[ax], bool)
+    sl = [np.s_[:]] * arr.ndim
+    sl[ax] = np.s_[1:]
+    sl2 = [np.s_[:]] * arr.ndim
+    sl2[ax] = np.s_[:-1]
+    neq = arr[tuple(sl)] != arr[tuple(sl2)]
+    while neq.ndim > 1:
+        neq = neq.any(axis=-1 if ax == 0 else 0)
+    take[1:] = neq
+    out = np.compress(take, arr, axis=ax)
+    results = [Tensor._wrap(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(take) - 1
+        results.append(Tensor._wrap(jnp.asarray(inv, np.int64)))
+    if return_counts:
+        idx = np.nonzero(take)[0]
+        counts = np.diff(np.append(idx, arr.shape[ax]))
+        results.append(Tensor._wrap(jnp.asarray(counts, np.int64)))
+    return results[0] if len(results) == 1 else tuple(results)
+
+
+def index_of(x, value):
+    raise NotImplementedError
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False,
+              name=None):
+    arr = np.asarray(input._data)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (arr.min(), arr.max())
+    w = np.asarray(weight._data) if weight is not None else None
+    h, _ = np.histogram(arr, bins=bins, range=(lo, hi), weights=w,
+                        density=density)
+    return Tensor._wrap(jnp.asarray(h))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    arr = np.asarray(x._data)
+    w = np.asarray(weights._data) if weights is not None else None
+    h, edges = np.histogramdd(arr, bins=bins, range=ranges, density=density,
+                              weights=w)
+    return (Tensor._wrap(jnp.asarray(h)),
+            [Tensor._wrap(jnp.asarray(e)) for e in edges])
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    if weights is not None:
+        return run_op("bincount",
+                      lambda a, w: jnp.bincount(
+                          a, w, minlength=minlength,
+                          length=int(np.asarray(x._data).max()) + 1
+                          if x.size else minlength),
+                      x, weights, differentiable=False)
+    n = int(np.asarray(x._data).max()) + 1 if x.size else 0
+    n = max(n, minlength)
+    return run_op("bincount",
+                  lambda a: jnp.bincount(a, minlength=minlength, length=n),
+                  x, differentiable=False)

@@ -1,0 +1,203 @@
+"""Optimizer base (reference: python/paddle/optimizer/optimizer.py:127).
+
+Design notes for the TPU build:
+- update math is pure jnp on raw buffers: eager it runs as XLA ops, and under
+  paddle_tpu.jit the whole optimizer.step() traces into the compiled train
+  step (the reference instead calls fused CUDA kernels, e.g. adamw.py:495).
+- multi_precision keeps fp32 master weights for bf16/fp16 params, matching
+  the reference master-weight behavior.
+- the learning rate lives in a device scalar (self._lr_t) so LR schedules
+  work inside compiled steps without retracing.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.autograd import no_grad
+from paddle_tpu.core import dtype as dtype_mod
+from paddle_tpu.core.tensor import Parameter, Tensor
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        if parameters is None:
+            raise ValueError(
+                "parameters is required in dygraph mode (pass "
+                "model.parameters())")
+        self._parameter_list = [p for p in parameters]
+        self._param_groups = None
+        if self._parameter_list and isinstance(self._parameter_list[0], dict):
+            self._param_groups = self._parameter_list
+            flat = []
+            for g in self._param_groups:
+                flat.extend(g["params"])
+            self._parameter_list = flat
+        self._learning_rate = learning_rate
+        self._lr_t = Tensor._wrap(jnp.asarray(
+            float(learning_rate.get_lr() if isinstance(
+                learning_rate, LRScheduler) else learning_rate),
+            jnp.float32))
+        if isinstance(learning_rate, LRScheduler):
+            learning_rate._bind_optimizer(self)
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self.regularization = weight_decay
+        self._weight_decay = weight_decay
+        self._accumulators: Dict[str, Dict[int, Tensor]] = defaultdict(dict)
+        self._master_weights: Dict[int, Tensor] = {}
+        self._global_step = 0
+
+    # ------------------------------------------------------------ lr API
+    def set_lr(self, value):
+        self._learning_rate = float(value)
+        self._lr_t._assign_array(jnp.asarray(float(value), jnp.float32))
+
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate.get_lr()
+        return float(self._learning_rate)
+
+    def _sync_lr(self):
+        """Refresh the device LR scalar from the schedule."""
+        self._lr_t._assign_array(jnp.asarray(self.get_lr(), jnp.float32))
+
+    def _lr_for(self, p):
+        base = self._lr_t._data
+        mult = getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
+        return base * mult if mult != 1.0 else base
+
+    # ---------------------------------------------------- accumulators
+    def _add_accumulator(self, name, p, fill=0.0, dtype=None,
+                         shape=None):
+        key = id(p)
+        if key not in self._accumulators[name]:
+            d = dtype or (jnp.float32 if self._multi_precision
+                          and p.dtype in (dtype_mod.bfloat16,
+                                          dtype_mod.float16)
+                          else p._data.dtype)
+            shp = tuple(shape) if shape is not None else p._data.shape
+            self._accumulators[name][key] = Tensor._wrap(
+                jnp.full(shp, fill, d))
+        return self._accumulators[name][key]
+
+    def _get_accumulator(self, name, p):
+        return self._accumulators[name][id(p)]
+
+    def _master_weight(self, p):
+        if p.dtype not in (dtype_mod.bfloat16, dtype_mod.float16) or \
+                not self._multi_precision:
+            return None
+        key = id(p)
+        if key not in self._master_weights:
+            self._master_weights[key] = Tensor._wrap(
+                p._data.astype(jnp.float32))
+        return self._master_weights[key]
+
+    # ----------------------------------------------------------- state
+    def _state_tensors(self) -> List[Tensor]:
+        """Every device buffer the optimizer mutates (threaded through
+        compiled train steps by paddle_tpu.jit)."""
+        out = [self._lr_t]
+        for d in self._accumulators.values():
+            out.extend(d.values())
+        out.extend(self._master_weights.values())
+        return out
+
+    def state_dict(self):
+        sd = {}
+        for name, d in self._accumulators.items():
+            for key, t in d.items():
+                idx = self._key_index(key)
+                sd[f"{name}_{idx}"] = t
+        for key, t in self._master_weights.items():
+            sd[f"master_{self._key_index(key)}"] = t
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        sd["global_step"] = self._global_step
+        return sd
+
+    def _key_index(self, key):
+        for i, p in enumerate(self._parameter_list):
+            if id(p) == key:
+                return i
+        return key
+
+    def set_state_dict(self, state_dict):
+        for name, d in self._accumulators.items():
+            for key in list(d):
+                idx = self._key_index(key)
+                k = f"{name}_{idx}"
+                if k in state_dict:
+                    v = state_dict[k]
+                    d[key]._assign_array(
+                        v._data if isinstance(v, Tensor)
+                        else jnp.asarray(np.asarray(v)))
+        for key in list(self._master_weights):
+            k = f"master_{self._key_index(key)}"
+            if k in state_dict:
+                v = state_dict[k]
+                self._master_weights[key]._assign_array(
+                    v._data if isinstance(v, Tensor)
+                    else jnp.asarray(np.asarray(v)))
+        if "LR_Scheduler" in state_dict and isinstance(
+                self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        self._global_step = state_dict.get("global_step", self._global_step)
+
+    # ------------------------------------------------------------ steps
+    def _grads(self):
+        pg = [(p, p.grad) for p in self._parameter_list
+              if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            pg = self._grad_clip(pg)
+        return pg
+
+    @no_grad()
+    def step(self):
+        self._create_accumulators()
+        self._sync_lr()
+        for p, g in self._grads():
+            self._append_optimize_op(p, g)
+        self._global_step += 1
+
+    def _create_accumulators(self):
+        pass
+
+    def _append_optimize_op(self, p, g):
+        raise NotImplementedError
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list:
+            p.clear_grad(set_to_zero=False)
+
+    clear_gradients = clear_grad
+
+    def _apply_update(self, p, new_value_f32_or_same):
+        """Write back, respecting master weights."""
+        mw = self._master_weight(p)
+        if mw is not None:
+            mw._assign_array(new_value_f32_or_same.astype(jnp.float32))
+            p._assign_array(
+                new_value_f32_or_same.astype(p._data.dtype))
+        else:
+            p._assign_array(new_value_f32_or_same.astype(p._data.dtype))
+
+    def _param_value(self, p):
+        mw = self._master_weight(p)
+        return mw._data if mw is not None else p._data
+
+    def _decayed(self, p, val, g):
+        """L2 weight decay folded into the gradient (reference
+        regularization semantics)."""
+        wd = self._weight_decay
+        if wd is None:
+            return g
+        coef = getattr(wd, "_coeff", None)
+        coef = float(coef) if coef is not None else float(wd)
+        return g + jnp.asarray(coef, g.dtype) * val.astype(g.dtype)

@@ -1,0 +1,311 @@
+"""Concrete optimizers (reference: python/paddle/optimizer/{sgd,momentum,
+adam,adamw,lamb,adagrad,rmsprop,adadelta,adamax}.py; the fused-kernel calls
+like adamw.py:495 become one fused XLA graph per param here)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _append_optimize_op(self, p, g):
+        val = self._param_value(p)
+        gd = self._decayed(p, val, g._data.astype(val.dtype))
+        self._apply_update(p, val - self._lr_for(p).astype(val.dtype) * gd)
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _create_accumulators(self):
+        for p in self._parameter_list:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, p, g):
+        val = self._param_value(p)
+        v = self._get_accumulator("velocity", p)
+        gd = self._decayed(p, val, g._data.astype(val.dtype))
+        new_v = self._momentum * v._data.astype(val.dtype) + gd
+        v._assign_array(new_v.astype(v._data.dtype))
+        lr = self._lr_for(p).astype(val.dtype)
+        if self._nesterov:
+            update = gd + self._momentum * new_v
+        else:
+            update = new_v
+        self._apply_update(p, val - lr * update)
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._amsgrad = amsgrad
+
+    def _create_accumulators(self):
+        for p in self._parameter_list:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow", p, fill=1.0, shape=())
+            self._add_accumulator("beta2_pow", p, fill=1.0, shape=())
+            if self._amsgrad:
+                self._add_accumulator("moment2_max", p)
+
+    def _adam_update(self, p, g, decoupled_wd=None):
+        val = self._param_value(p)
+        cdt = val.dtype
+        m1 = self._get_accumulator("moment1", p)
+        m2 = self._get_accumulator("moment2", p)
+        b1p = self._get_accumulator("beta1_pow", p)
+        b2p = self._get_accumulator("beta2_pow", p)
+        gd = g._data.astype(cdt)
+        if decoupled_wd is None:
+            gd = self._decayed(p, val, gd)
+        b1 = jnp.asarray(self._beta1, cdt)
+        b2 = jnp.asarray(self._beta2, cdt)
+        new_b1p = b1p._data.astype(cdt) * b1
+        new_b2p = b2p._data.astype(cdt) * b2
+        new_m1 = b1 * m1._data.astype(cdt) + (1 - b1) * gd
+        new_m2 = b2 * m2._data.astype(cdt) + (1 - b2) * gd * gd
+        m1._assign_array(new_m1.astype(m1._data.dtype))
+        m2._assign_array(new_m2.astype(m2._data.dtype))
+        b1p._assign_array(new_b1p.astype(b1p._data.dtype))
+        b2p._assign_array(new_b2p.astype(b2p._data.dtype))
+        mhat = new_m1 / (1 - new_b1p)
+        denom_m2 = new_m2
+        if self._amsgrad:
+            mmax = self._get_accumulator("moment2_max", p)
+            denom_m2 = jnp.maximum(mmax._data.astype(cdt), new_m2)
+            mmax._assign_array(denom_m2.astype(mmax._data.dtype))
+        vhat = denom_m2 / (1 - new_b2p)
+        lr = self._lr_for(p).astype(cdt)
+        new_val = val - lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        if decoupled_wd is not None:
+            new_val = new_val - lr * decoupled_wd * val
+        self._apply_update(p, new_val)
+
+    def _append_optimize_op(self, p, g):
+        self._adam_update(p, g)
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference optimizer/adamw.py — fused
+    adamw phi kernel at :495)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, amsgrad=False,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision,
+                         amsgrad, name)
+        self._wd = weight_decay if not hasattr(weight_decay, "_coeff") \
+            else float(weight_decay._coeff)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _append_optimize_op(self, p, g):
+        wd = self._wd
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(p.name):
+            wd = 0.0
+        self._adam_update(p, g, decoupled_wd=float(wd))
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _create_accumulators(self):
+        for p in self._parameter_list:
+            self._add_accumulator("moment", p, fill=self._init_acc)
+
+    def _append_optimize_op(self, p, g):
+        val = self._param_value(p)
+        acc = self._get_accumulator("moment", p)
+        gd = self._decayed(p, val, g._data.astype(val.dtype))
+        new_acc = acc._data.astype(val.dtype) + gd * gd
+        acc._assign_array(new_acc.astype(acc._data.dtype))
+        lr = self._lr_for(p).astype(val.dtype)
+        self._apply_update(
+            p, val - lr * gd / (jnp.sqrt(new_acc) + self._epsilon))
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _create_accumulators(self):
+        for p in self._parameter_list:
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("momentum", p)
+            if self._centered:
+                self._add_accumulator("mean_grad", p)
+
+    def _append_optimize_op(self, p, g):
+        val = self._param_value(p)
+        gd = self._decayed(p, val, g._data.astype(val.dtype))
+        ms = self._get_accumulator("mean_square", p)
+        mom = self._get_accumulator("momentum", p)
+        new_ms = self._rho * ms._data.astype(val.dtype) + \
+            (1 - self._rho) * gd * gd
+        ms._assign_array(new_ms.astype(ms._data.dtype))
+        denom = new_ms
+        if self._centered:
+            mg = self._get_accumulator("mean_grad", p)
+            new_mg = self._rho * mg._data.astype(val.dtype) + \
+                (1 - self._rho) * gd
+            mg._assign_array(new_mg.astype(mg._data.dtype))
+            denom = new_ms - new_mg * new_mg
+        lr = self._lr_for(p).astype(val.dtype)
+        new_mom = self._momentum * mom._data.astype(val.dtype) + \
+            lr * gd / jnp.sqrt(denom + self._epsilon)
+        mom._assign_array(new_mom.astype(mom._data.dtype))
+        self._apply_update(p, val - new_mom)
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _create_accumulators(self):
+        for p in self._parameter_list:
+            self._add_accumulator("avg_squared_grad", p)
+            self._add_accumulator("avg_squared_update", p)
+
+    def _append_optimize_op(self, p, g):
+        val = self._param_value(p)
+        gd = self._decayed(p, val, g._data.astype(val.dtype))
+        asg = self._get_accumulator("avg_squared_grad", p)
+        asu = self._get_accumulator("avg_squared_update", p)
+        new_asg = self._rho * asg._data.astype(val.dtype) + \
+            (1 - self._rho) * gd * gd
+        update = -jnp.sqrt(asu._data.astype(val.dtype) + self._epsilon) / \
+            jnp.sqrt(new_asg + self._epsilon) * gd
+        new_asu = self._rho * asu._data.astype(val.dtype) + \
+            (1 - self._rho) * update * update
+        asg._assign_array(new_asg.astype(asg._data.dtype))
+        asu._assign_array(new_asu.astype(asu._data.dtype))
+        lr = self._lr_for(p).astype(val.dtype)
+        self._apply_update(p, val + lr * update)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_accumulators(self):
+        for p in self._parameter_list:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow", p, fill=1.0, shape=())
+
+    def _append_optimize_op(self, p, g):
+        val = self._param_value(p)
+        gd = self._decayed(p, val, g._data.astype(val.dtype))
+        m = self._get_accumulator("moment", p)
+        u = self._get_accumulator("inf_norm", p)
+        b1p = self._get_accumulator("beta1_pow", p)
+        new_m = self._beta1 * m._data.astype(val.dtype) + \
+            (1 - self._beta1) * gd
+        new_u = jnp.maximum(self._beta2 * u._data.astype(val.dtype),
+                            jnp.abs(gd))
+        new_b1p = b1p._data.astype(val.dtype) * self._beta1
+        m._assign_array(new_m.astype(m._data.dtype))
+        u._assign_array(new_u.astype(u._data.dtype))
+        b1p._assign_array(new_b1p.astype(b1p._data.dtype))
+        lr = self._lr_for(p).astype(val.dtype)
+        self._apply_update(
+            p, val - lr / (1 - new_b1p) * new_m / (new_u + self._epsilon))
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._wd = lamb_weight_decay
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _create_accumulators(self):
+        for p in self._parameter_list:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow", p, fill=1.0, shape=())
+            self._add_accumulator("beta2_pow", p, fill=1.0, shape=())
+
+    def _append_optimize_op(self, p, g):
+        val = self._param_value(p)
+        cdt = val.dtype
+        gd = g._data.astype(cdt)
+        m1 = self._get_accumulator("moment1", p)
+        m2 = self._get_accumulator("moment2", p)
+        b1p = self._get_accumulator("beta1_pow", p)
+        b2p = self._get_accumulator("beta2_pow", p)
+        new_m1 = self._beta1 * m1._data.astype(cdt) + (1 - self._beta1) * gd
+        new_m2 = self._beta2 * m2._data.astype(cdt) + \
+            (1 - self._beta2) * gd * gd
+        new_b1p = b1p._data.astype(cdt) * self._beta1
+        new_b2p = b2p._data.astype(cdt) * self._beta2
+        m1._assign_array(new_m1.astype(m1._data.dtype))
+        m2._assign_array(new_m2.astype(m2._data.dtype))
+        b1p._assign_array(new_b1p.astype(b1p._data.dtype))
+        b2p._assign_array(new_b2p.astype(b2p._data.dtype))
+        mhat = new_m1 / (1 - new_b1p)
+        vhat = new_m2 / (1 - new_b2p)
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon)
+        wd = 0.0 if (self._exclude_fn is not None
+                     and self._exclude_fn(p)) else self._wd
+        r = r + wd * val
+        w_norm = jnp.sqrt(jnp.sum(val * val))
+        r_norm = jnp.sqrt(jnp.sum(r * r))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        lr = self._lr_for(p).astype(cdt)
+        self._apply_update(p, val - lr * trust * r)

@@ -1,0 +1,105 @@
+"""paddle.signal equivalent (stft/istft over jnp)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import run_op
+from paddle_tpu.core.tensor import Tensor
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    def f(a):
+        n = a.shape[axis]
+        num = 1 + (n - frame_length) // hop_length
+        idx = (np.arange(frame_length)[None, :]
+               + hop_length * np.arange(num)[:, None])
+        moved = jnp.moveaxis(a, axis, -1)
+        out = moved[..., idx]  # [..., num, frame_length]
+        return jnp.swapaxes(out, -1, -2)  # [..., frame_length, num]
+    return run_op("frame", f, x)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    def f(a):
+        # a: [..., frame_length, num_frames]
+        frame_length = a.shape[-2]
+        num = a.shape[-1]
+        n = frame_length + hop_length * (num - 1)
+        out = jnp.zeros(a.shape[:-2] + (n,), a.dtype)
+        for i in range(num):
+            out = out.at[..., i * hop_length:i * hop_length
+                         + frame_length].add(a[..., i])
+        return out
+    return run_op("overlap_add", f, x)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    def f(a, *maybe_win):
+        sig = a
+        if center:
+            pad = [(0, 0)] * (sig.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            sig = jnp.pad(sig, pad, mode=pad_mode)
+        n = sig.shape[-1]
+        num = 1 + (n - n_fft) // hop
+        idx = (np.arange(n_fft)[None, :] + hop * np.arange(num)[:, None])
+        frames = sig[..., idx]  # [..., num, n_fft]
+        if maybe_win:
+            w = maybe_win[0]
+            if wl < n_fft:
+                lpad = (n_fft - wl) // 2
+                w = jnp.pad(w, (lpad, n_fft - wl - lpad))
+            frames = frames * w
+        spec = jnp.fft.rfft(frames, axis=-1) if onesided \
+            else jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(n_fft)
+        return jnp.swapaxes(spec, -1, -2)  # [..., freq, num_frames]
+    if window is not None:
+        return run_op("stft", f, x, window)
+    return run_op("stft", f, x)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    def f(spec, *maybe_win):
+        s = jnp.swapaxes(spec, -1, -2)  # [..., frames, freq]
+        if normalized:
+            s = s * jnp.sqrt(n_fft)
+        frames = jnp.fft.irfft(s, n=n_fft, axis=-1) if onesided \
+            else jnp.real(jnp.fft.ifft(s, axis=-1))
+        if maybe_win:
+            w = maybe_win[0]
+            if wl < n_fft:
+                lpad = (n_fft - wl) // 2
+                w = jnp.pad(w, (lpad, n_fft - wl - lpad))
+        else:
+            w = jnp.ones((n_fft,), frames.dtype)
+        frames = frames * w
+        num = frames.shape[-2]
+        n = n_fft + hop * (num - 1)
+        out = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+        wsum = jnp.zeros((n,), frames.dtype)
+        for i in range(num):
+            out = out.at[..., i * hop:i * hop + n_fft].add(frames[..., i, :])
+            wsum = wsum.at[i * hop:i * hop + n_fft].add(w * w)
+        out = out / jnp.maximum(wsum, 1e-11)
+        if center:
+            out = out[..., n_fft // 2:]
+            if length is not None:
+                out = out[..., :length]
+            else:
+                out = out[..., : n - n_fft]
+        elif length is not None:
+            out = out[..., :length]
+        return out
+    if window is not None:
+        return run_op("istft", f, x, window)
+    return run_op("istft", f, x)

@@ -1,0 +1,39 @@
+"""Test config: force a CPU backend with 8 virtual devices so collective /
+sharding semantics are testable without TPU hardware (the reference's
+gloo-on-CPU "fake cluster" trick, SURVEY §4.2).
+
+The environment's axon shim (sitecustomize) registers a tunneled-TPU PJRT
+backend whose client creation can block when the tunnel is unhealthy; tests
+must never depend on it, so we hard-remove the axon/tpu factories and
+restore jax's original backend lookup before the first op runs.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+_xb._backend_factories.pop("tpu", None)
+_f = _xb._get_backend_uncached
+if getattr(_f, "__name__", "") == "_axon_get_backend_uncached" \
+        and _f.__closure__:
+    _xb._get_backend_uncached = _f.__closure__[0].cell_contents
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seeded():
+    import paddle_tpu
+    paddle_tpu.seed(2024)
+    np.random.seed(2024)
+    yield

@@ -1,0 +1,158 @@
+"""Autograd engine tests (reference semantics: eager/backward.cc RunBackward,
+grad accumulation, hooks, paddle.grad, PyLayer)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 4, 6])
+
+
+def test_chain():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x
+    z = y * x  # x^3 -> 3x^2 = 12
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 12.0)
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach_cuts_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).detach()
+    z = y * 3
+    assert z.stop_gradient
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor([[3.0, 1.0], [2.0, 4.0]], stop_gradient=False)
+    vals, idx = paddle.topk(x, k=1, axis=1)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 0], [0, 1]])
+
+
+def test_branching_graph():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    a = x * 2
+    b = x * 4
+    (a + b).backward()
+    np.testing.assert_allclose(x.grad.numpy(), 6.0)
+
+
+def test_backward_with_grad_tensor():
+    x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    y = x * 2
+    y.backward(paddle.to_tensor([1.0, 3.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 6.0])
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+
+
+def test_paddle_grad():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x
+    (g,) = paddle.grad(y, x)
+    np.testing.assert_allclose(g.numpy(), [4.0])
+    assert x.grad is None  # grad() must not touch .grad
+
+
+def test_paddle_grad_intermediate():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x
+    z = y * 3
+    (gy,) = paddle.grad(z, y)
+    np.testing.assert_allclose(gy.numpy(), [3.0])
+
+
+def test_register_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+    x.register_hook(lambda g: seen.append(g.numpy()))
+    (x * 5).backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], [5.0])
+
+
+def test_hook_modifies_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    x.register_hook(lambda g: g * 2)
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+def test_double_backward_raises_without_retain():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, dy):
+            return dy * 2
+
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_finite_difference_matmul():
+    rng = np.random.RandomState(0)
+    a = rng.randn(3, 4).astype(np.float32)
+    b = rng.randn(4, 2).astype(np.float32)
+    x = paddle.to_tensor(a, stop_gradient=False)
+    w = paddle.to_tensor(b, stop_gradient=False)
+    paddle.matmul(x, w).sum().backward()
+    # analytic: dL/dx = ones @ b.T
+    np.testing.assert_allclose(x.grad.numpy(),
+                               np.ones((3, 2)) @ b.T, rtol=1e-5)
+    np.testing.assert_allclose(w.grad.numpy(),
+                               a.T @ np.ones((3, 2)), rtol=1e-5)
+
+
+def test_jacobian():
+    x = paddle.to_tensor([1.0, 2.0])
+    jac = paddle.autograd.jacobian(lambda t: t * t, x)
+    np.testing.assert_allclose(jac.numpy(), np.diag([2.0, 4.0]))

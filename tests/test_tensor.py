@@ -1,0 +1,123 @@
+"""Tensor basics: creation, meta, dunders, indexing, inplace."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_and_meta():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert t.dtype == paddle.float32
+    assert t.ndim == 2
+    assert t.size == 4
+    np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_dtype_inference():
+    assert paddle.to_tensor([1, 2]).dtype == np.dtype(np.int32) or \
+        paddle.to_tensor([1, 2]).dtype == np.dtype(np.int64)
+    assert paddle.to_tensor(1.5).dtype == paddle.float32
+    assert paddle.to_tensor(True).dtype == paddle.bool
+
+
+def test_arithmetic_dunders():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    y = paddle.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((x + y).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((x - y).numpy(), [-3, -3, -3])
+    np.testing.assert_allclose((x * y).numpy(), [4, 10, 18])
+    np.testing.assert_allclose((y / x).numpy(), [4, 2.5, 2])
+    np.testing.assert_allclose((x ** 2).numpy(), [1, 4, 9])
+    np.testing.assert_allclose((-x).numpy(), [-1, -2, -3])
+    np.testing.assert_allclose((1 + x).numpy(), [2, 3, 4])
+    np.testing.assert_allclose((x @ y).numpy(), 32.0)
+
+
+def test_scalar_keeps_dtype():
+    x = paddle.to_tensor([1.0, 2.0], dtype="bfloat16")
+    assert (x + 1).dtype == paddle.bfloat16
+    assert (x * 2.0).dtype == paddle.bfloat16
+
+
+def test_promotion():
+    a = paddle.to_tensor([1], dtype="int32")
+    b = paddle.to_tensor([1.0], dtype="float32")
+    assert (a + b).dtype == paddle.float32
+
+
+def test_comparison():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    np.testing.assert_array_equal((x > 1.5).numpy(), [False, True, True])
+    np.testing.assert_array_equal((x == 2.0).numpy(), [False, True, False])
+
+
+def test_indexing():
+    x = paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    np.testing.assert_allclose(x[0, 1].numpy(), np.arange(4, 8))
+    np.testing.assert_allclose(x[:, -1, ::2].numpy(),
+                               np.arange(24).reshape(2, 3, 4)[:, -1, ::2])
+    idx = paddle.to_tensor([0, 1])
+    np.testing.assert_allclose(x[idx, idx].numpy(),
+                               np.arange(24).reshape(2, 3, 4)[[0, 1], [0, 1]])
+
+
+def test_setitem():
+    x = paddle.zeros([3, 3])
+    x[1] = 5.0
+    assert x.numpy()[1].tolist() == [5, 5, 5]
+    x[0, 0] = paddle.to_tensor(7.0)
+    assert x.numpy()[0, 0] == 7
+    assert x.inplace_version() >= 2
+
+
+def test_inplace_math():
+    x = paddle.to_tensor([1.0, 2.0])
+    x.add_(paddle.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(x.numpy(), [2, 3])
+    x.scale_(2.0)
+    np.testing.assert_allclose(x.numpy(), [4, 6])
+
+
+def test_cast_and_astype():
+    x = paddle.to_tensor([1.5, 2.5])
+    y = x.astype("int32")
+    assert y.dtype == paddle.int32
+    z = x.cast("bfloat16")
+    assert z.dtype == paddle.bfloat16
+
+
+def test_reshape_transpose_methods():
+    x = paddle.arange(6, dtype="float32")
+    y = x.reshape([2, 3])
+    assert y.shape == [2, 3]
+    assert y.T.shape == [3, 2]
+    assert x.unsqueeze(0).shape == [1, 6]
+    assert y.flatten().shape == [6]
+
+
+def test_clone_detach():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    d = x.detach()
+    assert d.stop_gradient
+    c = x.clone()
+    assert not c.stop_gradient
+
+
+def test_item_and_float():
+    x = paddle.to_tensor(3.5)
+    assert x.item() == 3.5
+    assert float(x) == 3.5
+    assert int(paddle.to_tensor(3)) == 3
+
+
+def test_save_load(tmp_path):
+    x = paddle.to_tensor([[1.0, 2.0]], dtype="bfloat16")
+    state = {"w": x, "step": 3}
+    p = str(tmp_path / "ckpt.pdparams")
+    paddle.save(state, p)
+    loaded = paddle.load(p)
+    assert loaded["step"] == 3
+    assert loaded["w"].dtype == paddle.bfloat16
+    np.testing.assert_allclose(loaded["w"].astype("float32").numpy(),
+                               [[1, 2]])
