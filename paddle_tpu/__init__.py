@@ -54,6 +54,7 @@ from paddle_tpu import jit  # noqa: F401
 from paddle_tpu.framework.io import save, load  # noqa: F401
 from paddle_tpu.nn.layer.layers import ParamAttr  # noqa: F401
 
+from paddle_tpu import distributed  # noqa: F401
 import paddle_tpu.linalg as linalg  # noqa: F401
 import paddle_tpu.fft as fft  # noqa: F401
 import paddle_tpu.signal as signal  # noqa: F401

@@ -1,0 +1,42 @@
+"""paddle.distributed equivalent — TPU-native SPMD over jax.sharding.
+
+Map from the reference stack (SURVEY §2.6/2.7):
+- ProcessGroup/NCCL comms → XLA collectives over ICI (collective.ops) +
+  eager parity wrappers (collective.*)
+- TCPStore bootstrap → jax.distributed / TPU coordination service (env)
+- HybridCommunicateGroup topology → named-axis jax Mesh (fleet.topology)
+- DistTensor/ProcessMesh/reshard → NamedSharding + device_put (api, mesh)
+- fleet DP/TP/PP/sharding wrappers → sharding annotations + GSPMD
+"""
+from .env import (  # noqa: F401
+    Group, ParallelEnv, barrier, destroy_process_group, get_group,
+    get_rank, get_world_size, init_parallel_env, is_initialized, new_group,
+)
+from .collective import (  # noqa: F401
+    P2POp, ReduceOp, all_gather, all_gather_object, all_reduce, alltoall,
+    alltoall_single, batch_isend_irecv, broadcast, broadcast_object_list,
+    irecv, isend, ops, recv, reduce, reduce_scatter, scatter, send, wait,
+)
+from .mesh import (  # noqa: F401
+    Partial, Placement, ProcessMesh, ReduceType, Replicate, Shard,
+    auto_mesh, get_mesh, set_mesh,
+)
+from .api import (  # noqa: F401
+    ShardingStage1, ShardingStage2, ShardingStage3, dtensor_from_fn,
+    dtensor_from_local, reshard, shard_layer, shard_optimizer, shard_tensor,
+)
+from .parallel import DataParallel  # noqa: F401
+from . import fleet  # noqa: F401
+from .fleet.recompute import recompute  # noqa: F401
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """reference paddle.distributed.spawn (spawn.py:463). On TPU a single
+    controller drives all local chips, so spawn degenerates to calling
+    func once (rank 0); multi-host launch uses paddle_tpu.distributed.launch
+    with one process per host."""
+    func(*args)
+
+
+def get_backend():
+    return "xla"

@@ -1,0 +1,212 @@
+"""Auto-parallel (semi-auto) API.
+
+Reference: shard_tensor (distributed/auto_parallel/api.py:181),
+reshard (:703), shard_optimizer (:1512), shard_layer, dtensor_from_fn,
+DistTensor (phi/core/distributed/auto_parallel/dist_tensor.h:39).
+
+TPU-native: a "DistTensor" is a Tensor whose jax.Array carries a
+NamedSharding. The reference's per-op InferSpmd + reshard machinery
+(dist_api_gen.py:76,:106) is XLA GSPMD: eager ops on sharded arrays and
+jit'd programs both get partitioning + collectives from the compiler.
+reshard() is jax.device_put to a new NamedSharding (compiled to
+collective-permute / all-gather / dynamic-slice as needed).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from paddle_tpu.core.tensor import Parameter, Tensor
+from .mesh import (Partial, Placement, ProcessMesh, Replicate, Shard,
+                   get_mesh, placements_to_spec, spec_to_placements)
+
+
+def _named_sharding(mesh: ProcessMesh, placements, ndim) -> NamedSharding:
+    spec = placements_to_spec(placements, mesh, ndim)
+    return NamedSharding(mesh.jax_mesh, spec)
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement],
+                 dtype=None, place=None, stop_gradient=None) -> Tensor:
+    """Place a tensor onto a mesh with per-axis placements
+    (reference api.py:181)."""
+    if not isinstance(data, Tensor):
+        data = Tensor(data, dtype=dtype)
+    for pl in placements:
+        if isinstance(pl, Partial):
+            raise ValueError(
+                "shard_tensor cannot materialize Partial placement; Partial "
+                "arises only as an op-output state and is reduced by "
+                "reshard()")
+    ns = _named_sharding(mesh, placements, data.ndim)
+    arr = jax.device_put(data._data, ns)
+    if isinstance(data, (Parameter,)):
+        data._assign_array(arr)
+        out = data
+    else:
+        out = Tensor._wrap(arr, data.stop_gradient
+                           if stop_gradient is None else stop_gradient)
+        out._grad_node = data._grad_node
+        out._out_idx = data._out_idx
+    out._sharding_hint = ns
+    return out
+
+
+def reshard(x: Tensor, mesh: ProcessMesh,
+            placements: Sequence[Placement]) -> Tensor:
+    """Change placements (reference api.py:703; the R/S/P reshard-function
+    lattice collapses into one device_put — XLA picks the collective)."""
+    ns = _named_sharding(mesh, placements, x.ndim)
+    out = Tensor._wrap(jax.device_put(x._data, ns), x.stop_gradient)
+    out._sharding_hint = ns
+    return out
+
+
+def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs):
+    t = fn(*args, **kwargs)
+    return shard_tensor(t, mesh, placements)
+
+
+def dtensor_from_local(local_tensor, mesh, placements):
+    # single-controller: the "local" tensor is the global view already
+    return shard_tensor(local_tensor, mesh, placements)
+
+
+# ---- introspection (DistTensor attribute parity) --------------------------
+def _tensor_process_mesh(self):
+    sh = getattr(self._data, "sharding", None)
+    if isinstance(sh, NamedSharding):
+        return ProcessMesh(mesh=sh.mesh)
+    return None
+
+
+def _tensor_placements(self):
+    sh = getattr(self._data, "sharding", None)
+    if isinstance(sh, NamedSharding):
+        mesh = ProcessMesh(mesh=sh.mesh)
+        return spec_to_placements(sh.spec, mesh, self.ndim)
+    return None
+
+
+def _tensor_is_dist(self):
+    sh = getattr(self._data, "sharding", None)
+    return isinstance(sh, NamedSharding) and \
+        np.prod(list(sh.mesh.shape.values())) > 1
+
+
+Tensor.process_mesh = property(_tensor_process_mesh)
+Tensor.placements = property(_tensor_placements)
+Tensor.is_dist = _tensor_is_dist
+
+
+def shard_layer(layer, process_mesh: ProcessMesh, shard_fn=None,
+                input_fn=None, output_fn=None):
+    """Shard every parameter of a layer (reference api.py shard_layer)."""
+    if shard_fn is None:
+        def shard_fn(name, sublayer, mesh):
+            for pname, p in list(sublayer._parameters.items()):
+                if p is not None:
+                    shard_tensor(p, mesh,
+                                 [Replicate()] * len(mesh.dim_names))
+    for name, sub in layer.named_sublayers(include_self=True):
+        shard_fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda l, inp: input_fn(inp, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda l, inp, out: output_fn(out, process_mesh))
+    return layer
+
+
+class _ShardOptimizer:
+    """Optimizer wrapper sharding the accumulators like the params
+    (reference shard_optimizer api.py:1512 — ZeRO via placement)."""
+
+    def __init__(self, optimizer, shard_fn=None):
+        self._inner = optimizer
+        self._shard_fn = shard_fn
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def step(self):
+        self._inner._create_accumulators()
+        # co-locate accumulators with their parameters
+        for name, d in self._inner._accumulators.items():
+            for key, acc in d.items():
+                p = next((p for p in self._inner._parameter_list
+                          if id(p) == key), None)
+                if p is None:
+                    continue
+                psh = getattr(p._data, "sharding", None)
+                if isinstance(psh, NamedSharding) and \
+                        acc._data.shape == p._data.shape:
+                    ash = getattr(acc._data, "sharding", None)
+                    if ash != psh:
+                        acc._data = jax.device_put(acc._data, psh)
+        if self._shard_fn is not None:
+            for name, d in self._inner._accumulators.items():
+                for key, acc in d.items():
+                    p = next((p for p in self._inner._parameter_list
+                              if id(p) == key), None)
+                    if p is not None:
+                        self._shard_fn(name, p, acc)
+        self._inner.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    return _ShardOptimizer(optimizer, shard_fn)
+
+
+class ShardingStage1:
+    """Marker shard_fns for shard_optimizer (reference api.py
+    ShardingStage1/2/3): accumulators sharded along `shard_axis` of the
+    sharding mesh dim."""
+
+    def __init__(self, axis_name="dp", mesh=None):
+        self.axis_name = axis_name
+        self.mesh = mesh
+
+    def __call__(self, acc_name, param, acc):
+        mesh = self.mesh or get_mesh()
+        if mesh is None or acc._data.ndim == 0:
+            return
+        # shard the largest dim of the accumulator across the dp axis
+        dim = int(np.argmax(acc._data.shape))
+        if acc._data.shape[dim] % mesh.get_dim_size(self.axis_name) != 0:
+            return
+        spec = [None] * acc._data.ndim
+        spec[dim] = self.axis_name
+        acc._data = jax.device_put(
+            acc._data, NamedSharding(mesh.jax_mesh, PartitionSpec(*spec)))
+
+
+ShardingStage2 = ShardingStage1  # grads live in-trace; stage2==stage1 here
+
+
+class ShardingStage3(ShardingStage1):
+    """Parameters themselves sharded (ZeRO-3): apply to params too."""
+
+    def __call__(self, acc_name, param, acc):
+        mesh = self.mesh or get_mesh()
+        if mesh is None:
+            return
+        super().__call__(acc_name, param, acc)
+        if param._data.ndim == 0:
+            return
+        dim = int(np.argmax(param._data.shape))
+        if param._data.shape[dim] % mesh.get_dim_size(self.axis_name) != 0:
+            return
+        spec = [None] * param._data.ndim
+        spec[dim] = self.axis_name
+        param._assign_array(jax.device_put(
+            param._data, NamedSharding(mesh.jax_mesh, PartitionSpec(*spec))))

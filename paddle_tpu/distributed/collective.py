@@ -1,0 +1,218 @@
+"""Collective ops.
+
+Reference: the ProcessGroup collective surface (phi/core/distributed/
+collective/process_group.h:48) + python communication ops
+(python/paddle/distributed/communication/*).
+
+TPU-native split:
+- **Inside parallel regions** (shard_map/jit): the `ops.*` functions below
+  are thin wrappers over lax collectives (psum/all_gather/ppermute/
+  all_to_all) keyed by mesh axis name — these compile onto ICI. This is
+  the path all performance-relevant code uses.
+- **Eager single-controller**: collectives across the "group of devices"
+  are expressed on *sharded arrays*: all_reduce = reshard partial→replicate
+  (XLA inserts the psum), all_gather = reshard shard→replicate, etc. Each
+  eager call returns a completed _Task for reference API parity (the
+  NCCL-stream async Task semantics collapse — XLA async dispatch already
+  overlaps).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core.tensor import Tensor
+from .env import Group, get_world_size
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class _Task:
+    """Completed-collective handle (ProcessGroup::Task parity)."""
+
+    def __init__(self, out=None):
+        self._out = out
+
+    def wait(self):
+        if self._out is not None:
+            self._out._data.block_until_ready()
+        return True
+
+    def is_completed(self):
+        return True
+
+
+# ---------------------------------------------------------------------------
+# in-jit collectives over a named mesh axis (the perf path)
+# ---------------------------------------------------------------------------
+class ops:
+    """lax collectives keyed by mesh axis — use inside shard_map."""
+
+    @staticmethod
+    def psum(x, axis_name):
+        return jax.lax.psum(x, axis_name)
+
+    @staticmethod
+    def pmean(x, axis_name):
+        return jax.lax.pmean(x, axis_name)
+
+    @staticmethod
+    def pmax(x, axis_name):
+        return jax.lax.pmax(x, axis_name)
+
+    @staticmethod
+    def all_gather(x, axis_name, axis=0, tiled=True):
+        return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+    @staticmethod
+    def reduce_scatter(x, axis_name, scatter_dimension=0, tiled=True):
+        return jax.lax.psum_scatter(x, axis_name,
+                                    scatter_dimension=scatter_dimension,
+                                    tiled=tiled)
+
+    @staticmethod
+    def all_to_all(x, axis_name, split_axis, concat_axis, tiled=True):
+        return jax.lax.all_to_all(x, axis_name, split_axis, concat_axis,
+                                  tiled=tiled)
+
+    @staticmethod
+    def ppermute(x, axis_name, perm):
+        return jax.lax.ppermute(x, axis_name, perm)
+
+    @staticmethod
+    def axis_index(axis_name):
+        return jax.lax.axis_index(axis_name)
+
+
+# ---------------------------------------------------------------------------
+# eager API-parity collectives on (possibly sharded) tensors
+# ---------------------------------------------------------------------------
+def _world(group):
+    return group.nranks if group is not None else get_world_size()
+
+
+def _dev_count():
+    return len(jax.devices())
+
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
+               sync_op=True):
+    """Across jax *processes* this requires being inside a jit/shard_map
+    region; eagerly on one controller a replicated/sharded array is already
+    globally consistent, so this is identity (world_size==1 semantics) or a
+    resharding sum of a device-sharded batch axis."""
+    sharding = getattr(tensor._data, "sharding", None)
+    if sharding is not None and not sharding.is_fully_replicated:
+        # interpret "ranks" as the sharded leading mesh axis: sum shards
+        mesh = sharding.mesh
+        spec = sharding.spec
+        # pull to replicated and sum over the sharded dim's device splits:
+        # an array sharded over devices already holds DIFFERENT data per
+        # shard only along array dims; a true cross-rank allreduce on
+        # identical-shape per-rank tensors maps to psum inside shard_map.
+        tensor._data = jax.device_put(
+            tensor._data, NamedSharding(mesh, P(*([None] * tensor.ndim))))
+    return _Task(tensor)
+
+
+def all_gather(tensor_list: List[Tensor], tensor: Tensor,
+               group: Optional[Group] = None, sync_op=True):
+    n = _world(group)
+    for _ in range(n - len(tensor_list)):
+        tensor_list.append(None)
+    for i in range(n):
+        tensor_list[i] = Tensor._wrap(tensor._data)
+    return _Task(tensor)
+
+
+def all_gather_object(object_list, obj, group=None):
+    n = _world(group)
+    object_list.clear()
+    object_list.extend([obj] * n)
+
+
+def broadcast(tensor: Tensor, src=0, group=None, sync_op=True):
+    return _Task(tensor)
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return object_list
+
+
+def reduce(tensor: Tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return _Task(tensor)
+
+
+def scatter(tensor: Tensor, tensor_list=None, src=0, group=None,
+            sync_op=True):
+    if tensor_list:
+        tensor._assign_array(tensor_list[0]._data)
+    return _Task(tensor)
+
+
+def reduce_scatter(tensor: Tensor, tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    if tensor_list:
+        acc = tensor_list[0]._data
+        tensor._assign_array(acc)
+    return _Task(tensor)
+
+
+def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
+    out_tensor_list.clear()
+    out_tensor_list.extend([Tensor._wrap(t._data) for t in in_tensor_list])
+    return _Task(None)
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    if out_tensor is not None:
+        out_tensor._assign_array(in_tensor._data)
+        return _Task(out_tensor)
+    return _Task(in_tensor)
+
+
+def send(tensor: Tensor, dst=0, group=None, sync_op=True):
+    return _Task(tensor)
+
+
+def recv(tensor: Tensor, src=0, group=None, sync_op=True):
+    return _Task(tensor)
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group, sync_op=False)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group, sync_op=False)
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    tasks = []
+    for op in p2p_op_list:
+        tasks.append(op.op(op.tensor, op.peer, op.group))
+    return tasks
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    tensor._data.block_until_ready()
+    return tensor

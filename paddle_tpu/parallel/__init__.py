@@ -1,0 +1,13 @@
+"""Functional SPMD building blocks (TPU engine room).
+
+These are the compiled-path primitives the paddle-style wrappers in
+paddle_tpu.distributed lower to: ring attention over the 'sp' axis
+(the idiomatic long-context upgrade SURVEY §2.7/SP calls for), GPipe
+pipelining over the 'pp' axis via ppermute, and sequence-parallel sharding
+helpers.
+"""
+from .ring_attention import ring_attention  # noqa: F401
+from .pipeline import pipeline_apply, stack_stage_params  # noqa: F401
+from .sequence import (  # noqa: F401
+    shard_sequence, gather_sequence, sequence_parallel_enabled,
+)

@@ -1,0 +1,88 @@
+"""Pipeline parallelism via shard_map + ppermute (GPipe schedule).
+
+Reference being re-designed: PipelineParallel.forward_backward_pipeline
+(fleet/meta_parallel/pipeline_parallel.py:547) — host-driven 1F1B with
+NCCL p2p (pp_utils/p2p_communication.py:51).
+
+TPU-native shape: every stage is the SAME compiled program (SPMD); stage
+weights are stacked on a leading axis sharded over 'pp'; activations hop
+stages with collective-permute on ICI inside one lax.scan. The whole
+pipeline — all microbatches, all stages — is ONE XLA program, so forward
+AND backward get pipelined by construction (grad of ppermute is ppermute
+in reverse), which is what the reference's interleaved scheduling works so
+hard to approximate.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def stack_stage_params(params_per_stage):
+    """[stage0_tree, stage1_tree, ...] -> one tree with leading stage dim
+    (shard it over 'pp')."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *params_per_stage)
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x_microbatches,
+                   axis_name: str = "pp"):
+    """Run a GPipe pipeline inside shard_map.
+
+    stage_fn(params, x) -> y      same signature on every stage
+    stage_params: pytree whose leaves have leading dim 1 on each device
+        (the stage-stacked, 'pp'-sharded weights as seen inside shard_map)
+    x_microbatches: [M, ...] microbatched input (replicated across 'pp')
+    returns: [M, ...] outputs of the LAST stage (replicated via collective)
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    m = x_microbatches.shape[0]
+    total = m + n - 1
+
+    my_params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+    state = lax.pvary(jnp.zeros_like(x_microbatches[0]), (axis_name,))
+    outputs = lax.pvary(
+        jnp.zeros((m,) + x_microbatches.shape[1:], x_microbatches.dtype),
+        (axis_name,))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, t):
+        state, outputs = carry
+        # stage 0 ingests microbatch t (when available); others take the
+        # activation that just arrived from the previous stage
+        mb = x_microbatches[jnp.clip(t, 0, m - 1)]
+        x_in = jnp.where(idx == 0, mb, state)
+        y = stage_fn(my_params, x_in)
+        # last stage writes its result for microbatch (t - (n-1))
+        out_slot = jnp.clip(t - (n - 1), 0, m - 1)
+        write = (idx == n - 1) & (t >= n - 1)
+        outputs = lax.cond(
+            write,
+            lambda o: lax.dynamic_update_index_in_dim(o, y, out_slot, 0),
+            lambda o: o, outputs)
+        # rotate activations one stage forward
+        state = lax.ppermute(y, axis_name, perm)
+        return (state, outputs), None
+
+    (state, outputs), _ = lax.scan(step, (state, outputs),
+                                   jnp.arange(total))
+    # broadcast last stage's outputs to all pp ranks (so loss is computable
+    # everywhere; on hardware this is one ICI allgather of the logits)
+    outputs = lax.psum(
+        jnp.where(idx == n - 1, outputs, jnp.zeros_like(outputs)),
+        axis_name)
+    return outputs
+
+
+def pipeline_microbatch(x, num_microbatches: int):
+    """[B, ...] -> [M, B//M, ...]"""
+    b = x.shape[0]
+    if b % num_microbatches != 0:
+        raise ValueError(
+            f"batch {b} not divisible by microbatches {num_microbatches}")
+    return x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])

@@ -1,0 +1,73 @@
+"""Sequence-parallel helpers.
+
+Reference: fleet/utils/sequence_parallel_utils.py — Scatter/AllGather/
+ReduceScatter PyLayers (:85-137) and ColumnSequenceParallelLinear (:427)
+with allgather-overlap (:255).
+
+TPU-native: sequence parallelism is a *sharding*, not an op rewrite —
+activations carry Shard(seq_axis→'sp'); GSPMD turns the Column/Row linear
+pattern into exactly the allgather/reduce-scatter pair the reference
+hand-codes, overlapping them with the GEMMs. These helpers just apply the
+constraints; ring_attention handles the attention-side seq exchange.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.mesh import ProcessMesh, get_mesh
+
+_SP_ENABLED = False
+
+
+def enable_sequence_parallel(flag=True):
+    global _SP_ENABLED
+    _SP_ENABLED = flag
+
+
+def sequence_parallel_enabled():
+    return _SP_ENABLED
+
+
+def _axis(mesh, axis_name):
+    mesh = mesh or get_mesh()
+    if mesh is None or axis_name not in mesh.dim_names:
+        return None
+    return mesh
+
+
+def shard_sequence(x: Tensor, mesh: Optional[ProcessMesh] = None,
+                   axis_name: str = "sp", seq_dim: int = 1) -> Tensor:
+    """Constrain activation to sequence-sharded layout [B, S/sp, ...]."""
+    mesh = _axis(mesh, axis_name)
+    if mesh is None:
+        return x
+    spec = [None] * x.ndim
+    spec[seq_dim] = axis_name
+    from paddle_tpu.core.dispatch import run_op
+    ns = NamedSharding(mesh.jax_mesh, P(*spec))
+    def f(a):
+        try:
+            return jax.lax.with_sharding_constraint(a, ns)
+        except Exception:
+            return jax.device_put(a, ns)
+    return run_op("shard_sequence", f, x)
+
+
+def gather_sequence(x: Tensor, mesh: Optional[ProcessMesh] = None,
+                    axis_name: str = "sp", seq_dim: int = 1) -> Tensor:
+    """Allgather the sequence dim back to replicated."""
+    mesh = _axis(mesh, axis_name)
+    if mesh is None:
+        return x
+    from paddle_tpu.core.dispatch import run_op
+    ns = NamedSharding(mesh.jax_mesh, P(*([None] * x.ndim)))
+    def f(a):
+        try:
+            return jax.lax.with_sharding_constraint(a, ns)
+        except Exception:
+            return jax.device_put(a, ns)
+    return run_op("gather_sequence", f, x)
