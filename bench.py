@@ -1,0 +1,84 @@
+"""Flagship benchmark: GPT causal-LM training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+vs_baseline: measured tokens/sec vs the BASELINE.md north star proxy — an
+8xA100 NCCL per-chip rate estimated at 40% MFU of A100 bf16 peak
+(312 TFLOP/s) on the same model: tokens/s = 0.4*312e12 / flops_per_token.
+(The reference publishes no numbers — BASELINE.md; this pins the ratio to
+a reproducible formula instead.)
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    if os.environ.get("BENCH_CPU") == "1":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import jax._src.xla_bridge as xb
+        xb._backend_factories.pop("axon", None)
+        xb._backend_factories.pop("tpu", None)
+        f = xb._get_backend_uncached
+        if getattr(f, "__name__", "") == "_axon_get_backend_uncached" \
+                and f.__closure__:
+            xb._get_backend_uncached = f.__closure__[0].cell_contents
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.models.gpt_hybrid import ParallelConfig, setup
+
+    on_cpu = jax.default_backend() == "cpu"
+    if on_cpu:
+        cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                        num_heads=4, max_seq_len=128)
+        batch, seq, steps, warmup = 2, 128, 3, 1
+    else:
+        # GPT-medium-class (~350M params) — fits v5e 16GB with remat
+        cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
+                        num_heads=16, max_seq_len=1024)
+        batch, seq, steps, warmup = 8, 1024, 10, 2
+    pcfg = ParallelConfig(dp=1, pp=1, tp=1, remat=True,
+                          param_dtype=jnp.bfloat16,
+                          compute_dtype=jnp.bfloat16)
+    mesh, params, opt_state, step = setup(cfg, pcfg, seed=0,
+                                          devices=jax.devices()[:1])
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    with mesh:
+        for _ in range(warmup):
+            params, opt_state, loss = step(params, opt_state, (ids, ids))
+        loss.block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, (ids, ids))
+        loss.block_until_ready()
+        dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+
+    # A100@40%MFU proxy for this exact model (6*N + 12*L*H*S attention)
+    h, L, s = cfg.hidden_size, cfg.num_layers, seq
+    n_params = (cfg.vocab_size * h + cfg.max_seq_len * h
+                + L * (12 * h * h + 13 * h) + 2 * h)
+    flops_per_token = 6 * n_params + 12 * L * h * s
+    a100_baseline = 0.4 * 312e12 / flops_per_token
+    print(json.dumps({
+        "metric": "gpt350m_train_tokens_per_sec_per_chip"
+        if not on_cpu else "gpt_tiny_cpu_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tokens_per_sec / a100_baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

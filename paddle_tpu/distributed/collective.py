@@ -107,21 +107,18 @@ def _dev_count():
 
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
                sync_op=True):
-    """Across jax *processes* this requires being inside a jit/shard_map
-    region; eagerly on one controller a replicated/sharded array is already
-    globally consistent, so this is identity (world_size==1 semantics) or a
-    resharding sum of a device-sharded batch axis."""
+    """Eager single-controller semantics: a replicated array is already
+    globally consistent → identity. A device-sharded array holds different
+    data only along ARRAY dims (there is no per-rank hidden copy to
+    reduce), so the per-rank allreduce of the reference maps to
+    collective.ops.psum/pmax/... inside shard_map — use that in parallel
+    regions. A sharded eager input is gathered to replicated (its global
+    value is unchanged; no reduction is performed)."""
     sharding = getattr(tensor._data, "sharding", None)
     if sharding is not None and not sharding.is_fully_replicated:
-        # interpret "ranks" as the sharded leading mesh axis: sum shards
-        mesh = sharding.mesh
-        spec = sharding.spec
-        # pull to replicated and sum over the sharded dim's device splits:
-        # an array sharded over devices already holds DIFFERENT data per
-        # shard only along array dims; a true cross-rank allreduce on
-        # identical-shape per-rank tensors maps to psum inside shard_map.
         tensor._data = jax.device_put(
-            tensor._data, NamedSharding(mesh, P(*([None] * tensor.ndim))))
+            tensor._data,
+            NamedSharding(sharding.mesh, P(*([None] * tensor.ndim))))
     return _Task(tensor)
 
 

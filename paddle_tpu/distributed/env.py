@@ -24,8 +24,10 @@ class Group:
 
     def __init__(self, rank: int, ranks: List[int], gid: int = 0,
                  name: Optional[str] = None):
-        self.rank = rank if rank in range(len(ranks)) else -1
+        # rank is the GLOBAL rank; store the group-local rank (-1 = not a
+        # member), matching the reference Group semantics
         self.ranks = list(ranks)
+        self.rank = self.ranks.index(rank) if rank in self.ranks else -1
         self.nranks = len(ranks)
         self.id = gid
         self._name = name or f"group_{gid}"
@@ -72,9 +74,12 @@ def init_parallel_env() -> Group:
     nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     pid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
     if nprocs > 1 and coord and not jax._src.distributed.global_state.client:
-        port = os.environ.get("MASTER_PORT", "8476")
+        # PADDLE_MASTER conventionally carries host:port; fall back to
+        # MASTER_PORT only when no port is embedded
+        host, _, port = coord.partition(":")
+        port = port or os.environ.get("MASTER_PORT", "8476")
         jax.distributed.initialize(
-            coordinator_address=f"{coord.split(':')[0]}:{port}",
+            coordinator_address=f"{host}:{port}",
             num_processes=nprocs, process_id=pid)
     _INITIALIZED = True
     world = list(range(get_world_size()))
@@ -127,10 +132,14 @@ def destroy_process_group(group=None):
 
 
 def barrier(group=None):
-    # single-controller: device sync is the barrier; multi-host: psum over
-    # a scalar forces coordination
+    """Single-host: device sync. Multi-host: a coordination-service sync
+    (the real cross-process barrier)."""
     import jax.numpy as jnp
-    jnp.zeros(()).block_until_ready()
+    if get_world_size() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+    else:
+        jnp.zeros(()).block_until_ready()
 
 
 class ParallelEnv:

@@ -118,7 +118,7 @@ class _Fleet:
         if hcg.get_pipe_parallel_world_size() > 1 and \
                 isinstance(model, PipelineLayer):
             model.build_pipeline(hcg)
-        if hcg.get_data_parallel_world_size() > 1 or True:
+        if hcg.get_data_parallel_world_size() > 1:
             model = DataParallel(model, mesh=hcg.process_mesh)
         return model
 

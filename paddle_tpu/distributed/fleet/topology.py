@@ -97,14 +97,15 @@ class HybridCommunicateGroup:
         dims = [topology.get_dim(nm) for nm in names]
         self.mesh = ProcessMesh(shape=dims, dim_names=names,
                                 devices=devs[:n])
-        coord = self._topo.get_coord(self.global_rank % n)
+        my_rank = self.global_rank % n
+        coord = self._topo.get_coord(my_rank)
         self._coord = dict(zip(names, coord))
         self._groups: Dict[str, Group] = {}
         for nm in names:
-            ranks = self._topo.get_axis_list(
-                nm, 0)  # representative; per-rank groups equal by symmetry
-            self._groups[nm] = new_group(
-                self._topo.get_comm_list(nm)[0])
+            # the comm group along axis `nm` CONTAINING this process
+            comm = next(g for g in self._topo.get_comm_list(nm)
+                        if my_rank in g)
+            self._groups[nm] = new_group(comm)
 
     # --- degree queries (reference API) ---
     def get_data_parallel_world_size(self):

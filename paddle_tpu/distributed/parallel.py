@@ -32,12 +32,20 @@ class DataParallel(Layer):
             mesh = ProcessMesh(shape=[n], dim_names=["dp"])
             set_mesh(mesh)
         self._mesh = mesh
-        # replicate parameters and buffers across the mesh
+        # replicate parameters/buffers across the mesh — but leave anything
+        # a TP/sharding layer already placed (e.g. mp-sharded weights) alone
         rep = NamedSharding(mesh.jax_mesh, P())
+        def _replicate(t):
+            sh = getattr(t._data, "sharding", None)
+            already_dist = sh is not None and not getattr(
+                sh, "is_fully_replicated", True) and len(
+                    t._data.devices()) > 1
+            if not already_dist:
+                t._assign_array(jax.device_put(t._data, rep))
         for _, p in layers.named_parameters():
-            p._assign_array(jax.device_put(p._data, rep))
+            _replicate(p)
         for _, b in layers.named_buffers():
-            b._assign_array(jax.device_put(b._data, rep))
+            _replicate(b)
 
     def _shard_input(self, t: Tensor) -> Tensor:
         if not isinstance(t, Tensor) or t.ndim == 0:

@@ -1,0 +1,15 @@
+"""Model zoo (reference analogs: PaddleNLP gpt/llama/bert configs used by
+test/auto_parallel/hybrid_strategy/semi_auto_parallel_llama_model.py and
+paddle.vision.models; BASELINE.json workload configs).
+
+Submodules import lazily — `from paddle_tpu.models import gpt` etc.
+"""
+import importlib
+
+__all__ = ["gpt", "gpt_hybrid", "llama", "bert", "moe", "resnet"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(name)

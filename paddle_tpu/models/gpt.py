@@ -1,0 +1,146 @@
+"""GPT decoder-only LM (flagship; BASELINE.json config 3: GPT-3 1.3B).
+
+Dygraph model built from paddle_tpu.nn layers; TP-aware when a hybrid
+mesh with an 'mp' axis is active (fleet Column/Row parallel layers).
+The compiled hybrid-parallel training path lives in gpt_hybrid.py.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 1024
+    ffn_mult: int = 4
+    dropout: float = 0.0
+    tie_embeddings: bool = True
+    use_tensor_parallel: bool = False
+
+    @staticmethod
+    def gpt3_1p3b():
+        return GPTConfig(vocab_size=50304, hidden_size=2048, num_layers=24,
+                         num_heads=16, max_seq_len=2048)
+
+    @staticmethod
+    def tiny():
+        return GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                         num_heads=4, max_seq_len=64)
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        h = cfg.hidden_size
+        if cfg.use_tensor_parallel:
+            from paddle_tpu.distributed import fleet
+            self.qkv = fleet.ColumnParallelLinear(h, 3 * h,
+                                                  gather_output=False)
+            self.proj = fleet.RowParallelLinear(h, h,
+                                                input_is_parallel=True)
+        else:
+            self.qkv = nn.Linear(h, 3 * h)
+            self.proj = nn.Linear(h, h)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        b, s, h = x.shape
+        nh = self.cfg.num_heads
+        qkv = self.qkv(x).reshape([b, s, 3, nh, h // nh])
+        q, k, v = qkv.unbind(axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True,
+            dropout_p=self.cfg.dropout, training=self.training)
+        out = out.reshape([b, s, h])
+        return self.drop(self.proj(out))
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        h, m = cfg.hidden_size, cfg.hidden_size * cfg.ffn_mult
+        if cfg.use_tensor_parallel:
+            from paddle_tpu.distributed import fleet
+            self.fc1 = fleet.ColumnParallelLinear(h, m, gather_output=False)
+            self.fc2 = fleet.RowParallelLinear(m, h, input_is_parallel=True)
+        else:
+            self.fc1 = nn.Linear(h, m)
+            self.fc2 = nn.Linear(m, h)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        return self.drop(self.fc2(F.gelu(self.fc1(x), approximate=True)))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(cfg.hidden_size)
+        self.attn = GPTAttention(cfg)
+        self.ln2 = nn.LayerNorm(cfg.hidden_size)
+        self.mlp = GPTMLP(cfg)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln1(x))
+        x = x + self.mlp(self.ln2(x))
+        return x
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        if cfg.use_tensor_parallel:
+            from paddle_tpu.distributed import fleet
+            self.wte = fleet.VocabParallelEmbedding(cfg.vocab_size,
+                                                    cfg.hidden_size)
+        else:
+            self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = nn.Embedding(cfg.max_seq_len, cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.dropout)
+        self.blocks = nn.LayerList([GPTBlock(cfg)
+                                    for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size)
+        if not cfg.tie_embeddings:
+            self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                     bias_attr=False)
+
+    def forward(self, input_ids):
+        b, s = input_ids.shape
+        pos = paddle.arange(s, dtype="int64").unsqueeze(0)
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = self.drop(x)
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.ln_f(x)
+        if self.cfg.tie_embeddings:
+            logits = paddle.matmul(x, self.wte.weight, transpose_y=True)
+        else:
+            logits = self.lm_head(x)
+        return logits
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(cfg)
+        self.loss_fn = nn.CrossEntropyLoss()
+
+    def forward(self, input_ids, labels=None):
+        logits = self.gpt(input_ids)
+        if labels is None:
+            return logits
+        loss = self.loss_fn(
+            logits[:, :-1].reshape([-1, logits.shape[-1]]),
+            labels[:, 1:].reshape([-1]))
+        return loss

@@ -1,0 +1,395 @@
+"""Hybrid-parallel GPT training engine (the compiled perf path).
+
+Re-designs the reference's fleet hybrid-parallel train loop (SURVEY §3.5:
+PipelineParallel.train_batch + TP layers + sharding + MoE all-to-all) as
+ONE jitted SPMD program over a (dp, pp, tp) mesh:
+
+- dp  : batch sharded; grad psum inserted by XLA (replaces EagerReducer)
+- tp  : Megatron shardings on qkv/proj/fc weights; collectives from GSPMD
+        (replaces mp_ops allreduce/allgather PyLayers)
+- sp  : activations between blocks sequence-sharded over the tp axis
+        (Megatron-LM SP, sequence_parallel_utils.py equivalent)
+- pp  : stages stacked on a leading axis, manual shard_map over 'pp' with
+        ppermute microbatch rotation (replaces 1F1B host scheduling);
+        dp/tp stay GSPMD-auto inside the manual region (axis_names={'pp'})
+- ep  : MoE expert dim sharded over the dp axis (DeepSpeed-MoE style
+        EP=DP); GShard dense-dispatch einsum → XLA emits the all-to-alls
+        (replaces global_scatter/global_gather, moe_layer.py:263)
+- ZeRO-1/2: optimizer moments sharded over dp via sharding constraints
+  (replaces DygraphShardingOptimizer)
+- remat: jax.checkpoint per block (replaces RecomputeFunction)
+
+Everything below is pure-functional jax (no eager Tensor) — this is the
+engine the paddle-style wrappers lower to, and what bench.py measures.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .gpt import GPTConfig
+
+
+@dataclass
+class ParallelConfig:
+    dp: int = 1
+    pp: int = 1
+    tp: int = 1
+    sp: bool = False          # sequence-shard activations over tp axis
+    num_experts: int = 0      # >0 turns MLP into MoE (EP over dp axis)
+    microbatches: int = 1     # pipeline microbatches (pp>1)
+    remat: bool = True
+    zero1: bool = True        # shard adam moments over dp
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+
+
+def build_mesh(pcfg: ParallelConfig, devices=None) -> Mesh:
+    devs = devices if devices is not None else jax.devices()
+    n = pcfg.dp * pcfg.pp * pcfg.tp
+    if n > len(devs):
+        raise ValueError(f"need {n} devices, have {len(devs)}")
+    arr = np.asarray(devs[:n]).reshape(pcfg.dp, pcfg.pp, pcfg.tp)
+    return Mesh(arr, ("dp", "pp", "tp"))
+
+
+# ------------------------------ init ---------------------------------------
+def _init(key, shape, scale, dtype):
+    return (jax.random.normal(key, shape) * scale).astype(dtype)
+
+
+def init_params(cfg: GPTConfig, pcfg: ParallelConfig, key) -> Dict:
+    h = cfg.hidden_size
+    m = h * cfg.ffn_mult
+    L = cfg.num_layers
+    dt = pcfg.param_dtype
+    std = 0.02
+    ks = jax.random.split(key, 16)
+    blocks: Dict[str, Any] = {
+        "ln1_g": jnp.ones((L, h), dt), "ln1_b": jnp.zeros((L, h), dt),
+        "qkv_w": _init(ks[0], (L, h, 3 * h), std, dt),
+        "qkv_b": jnp.zeros((L, 3 * h), dt),
+        "proj_w": _init(ks[1], (L, h, h), std / math.sqrt(2 * L), dt),
+        "proj_b": jnp.zeros((L, h), dt),
+        "ln2_g": jnp.ones((L, h), dt), "ln2_b": jnp.zeros((L, h), dt),
+    }
+    if pcfg.num_experts > 0:
+        e = pcfg.num_experts
+        blocks.update({
+            "gate_w": _init(ks[2], (L, h, e), std, dt),
+            "fc1_w": _init(ks[3], (L, e, h, m), std, dt),
+            "fc1_b": jnp.zeros((L, e, m), dt),
+            "fc2_w": _init(ks[4], (L, e, m, h), std / math.sqrt(2 * L), dt),
+            "fc2_b": jnp.zeros((L, e, h), dt),
+        })
+    else:
+        blocks.update({
+            "fc1_w": _init(ks[3], (L, h, m), std, dt),
+            "fc1_b": jnp.zeros((L, m), dt),
+            "fc2_w": _init(ks[4], (L, m, h), std / math.sqrt(2 * L), dt),
+            "fc2_b": jnp.zeros((L, h), dt),
+        })
+    params = {
+        "wte": _init(ks[5], (cfg.vocab_size, h), std, dt),
+        "wpe": _init(ks[6], (cfg.max_seq_len, h), std, dt),
+        "blocks": blocks,
+        "lnf_g": jnp.ones((h,), dt), "lnf_b": jnp.zeros((h,), dt),
+    }
+    return params
+
+
+def param_specs(cfg: GPTConfig, pcfg: ParallelConfig) -> Dict:
+    """NamedSharding specs: tp = Megatron; pp = leading stage dim; ep = dp."""
+    pp = "pp" if pcfg.pp > 1 else None
+    moe = pcfg.num_experts > 0
+    blocks = {
+        "ln1_g": P(pp, None), "ln1_b": P(pp, None),
+        "qkv_w": P(pp, None, "tp"), "qkv_b": P(pp, "tp"),
+        "proj_w": P(pp, "tp", None), "proj_b": P(pp, None),
+        "ln2_g": P(pp, None), "ln2_b": P(pp, None),
+    }
+    if moe:
+        blocks.update({
+            "gate_w": P(pp, None, None),
+            "fc1_w": P(pp, "dp", None, "tp"), "fc1_b": P(pp, "dp", "tp"),
+            "fc2_w": P(pp, "dp", "tp", None), "fc2_b": P(pp, "dp", None),
+        })
+    else:
+        blocks.update({
+            "fc1_w": P(pp, None, "tp"), "fc1_b": P(pp, "tp"),
+            "fc2_w": P(pp, "tp", None), "fc2_b": P(pp, None),
+        })
+    return {
+        "wte": P("tp", None), "wpe": P(None, None),
+        "blocks": blocks,
+        "lnf_g": P(None), "lnf_b": P(None),
+    }
+
+
+def shard_params(params, mesh, cfg, pcfg):
+    specs = param_specs(cfg, pcfg)
+    if pcfg.pp > 1:
+        # blocks leaves [L, ...] -> [pp, L/pp, ...]; stage dim carries 'pp',
+        # the per-layer dim is unsharded, trailing dims keep their tp/ep spec
+        L = cfg.num_layers
+        params = dict(params)
+        params["blocks"] = jax.tree_util.tree_map(
+            lambda x: x.reshape((pcfg.pp, L // pcfg.pp) + x.shape[1:]),
+            params["blocks"])
+        flat_specs = param_specs(
+            cfg, ParallelConfig(**{**pcfg.__dict__, "pp": 1}))["blocks"]
+        specs = dict(specs)
+        specs["blocks"] = jax.tree_util.tree_map(
+            lambda s: P("pp", None, *tuple(s)[1:]), flat_specs)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs), specs
+
+
+# ---------------------------- forward --------------------------------------
+def _layer_norm(x, g, b, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.var(xf, -1, keepdims=True)
+    return ((xf - mu) * lax.rsqrt(var + eps)).astype(x.dtype) * g + b
+
+
+def _attend(q, k, v, nh):
+    b, s, h = q.shape
+    d = h // nh
+    q = q.reshape(b, s, nh, d)
+    k = k.reshape(b, s, nh, d)
+    v = v.reshape(b, s, nh, d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) / math.sqrt(d)
+    iq = lax.broadcasted_iota(jnp.int32, (s, s), 0)
+    ik = lax.broadcasted_iota(jnp.int32, (s, s), 1)
+    logits = jnp.where((iq >= ik)[None, None], logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return out.reshape(b, s, h)
+
+
+def _constrain(x, spec, mesh):
+    try:
+        return lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    except Exception:
+        return x
+
+
+def _moe_ffn(x, lp, pcfg, mesh):
+    """GShard-style dense-dispatch switch MoE; expert dim sharded over dp
+    (EP=DP) → XLA emits all-to-all over ICI."""
+    b, s, h = x.shape
+    e = pcfg.num_experts
+    tokens = x.reshape(b * s, h)
+    gate_logits = tokens.astype(jnp.float32) @ \
+        lp["gate_w"].astype(jnp.float32)
+    probs = jax.nn.softmax(gate_logits, -1)
+    top = jnp.argmax(probs, -1)
+    gate = jnp.max(probs, -1).astype(x.dtype)
+    disp = jax.nn.one_hot(top, e, dtype=x.dtype)          # [T, E]
+    xin = jnp.einsum("te,th->eth", disp, tokens)          # dispatch
+    hmid = jax.nn.gelu(
+        jnp.einsum("eth,ehm->etm", xin, lp["fc1_w"])
+        + lp["fc1_b"][:, None, :])
+    hout = jnp.einsum("etm,emh->eth", hmid, lp["fc2_w"]) \
+        + lp["fc2_b"][:, None, :]
+    combined = jnp.einsum("te,eth->th", disp, hout) * gate[:, None]
+    return combined.reshape(b, s, h)
+
+
+def _block(x, lp, cfg, pcfg, mesh):
+    act_spec = P("dp", "tp", None) if pcfg.sp else P("dp", None, None)
+    x = _constrain(x, act_spec, mesh)
+    hres = x
+    hx = _layer_norm(x, lp["ln1_g"], lp["ln1_b"])
+    qkv = hx @ lp["qkv_w"] + lp["qkv_b"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    attn = _attend(q, k, v, cfg.num_heads)
+    attn = attn @ lp["proj_w"] + lp["proj_b"]
+    x = hres + attn
+    x = _constrain(x, act_spec, mesh)
+    hres = x
+    hx = _layer_norm(x, lp["ln2_g"], lp["ln2_b"])
+    if pcfg.num_experts > 0:
+        ff = _moe_ffn(hx, lp, pcfg, mesh)
+    else:
+        ff = jax.nn.gelu(hx @ lp["fc1_w"] + lp["fc1_b"]) @ lp["fc2_w"] \
+            + lp["fc2_b"]
+    x = hres + ff
+    return _constrain(x, act_spec, mesh)
+
+
+def _stack_apply(blocks, x, cfg, pcfg, mesh):
+    """lax.scan over the (local) layer stack — one compiled block body."""
+    def body(h, lp):
+        fn = functools.partial(_block, cfg=cfg, pcfg=pcfg, mesh=mesh)
+        if pcfg.remat:
+            fn = jax.checkpoint(fn)
+        return fn(h, lp), None
+    out, _ = lax.scan(body, x, blocks)
+    return out
+
+
+def forward(params, input_ids, cfg: GPTConfig, pcfg: ParallelConfig,
+            mesh: Mesh):
+    cdt = pcfg.compute_dtype
+    b, s = input_ids.shape
+    x = params["wte"][input_ids].astype(cdt) + \
+        params["wpe"][:s][None].astype(cdt)
+    x = _constrain(x, P("dp", None, None), mesh)
+    blocks = jax.tree_util.tree_map(lambda p: p.astype(cdt),
+                                    params["blocks"])
+
+    if pcfg.pp > 1:
+        from paddle_tpu.parallel.pipeline import (pipeline_apply,
+                                                  pipeline_microbatch)
+        mb = pipeline_microbatch(x, pcfg.microbatches)
+
+        def stage_fn(stage_params, xm):
+            return _stack_apply(stage_params, xm, cfg, pcfg, mesh)
+
+        def pp_body(blocks_stacked, mb):
+            my = jax.tree_util.tree_map(lambda p: p[0], blocks_stacked)
+            n = lax.axis_size("pp")
+            idx = lax.axis_index("pp")
+            m_count = mb.shape[0]
+            state = lax.pcast(jnp.zeros_like(mb[0]), ("pp",), to='varying')
+            outs = lax.pcast(jnp.zeros_like(mb), ("pp",), to='varying')
+            perm = [(i, (i + 1) % n) for i in range(n)]
+
+            def compute(t, state, outs):
+                x_in = jnp.where(idx == 0, mb[jnp.clip(t, 0, m_count - 1)],
+                                 state)
+                y = stage_fn(my, x_in)
+                slot = jnp.clip(t - (n - 1), 0, m_count - 1)
+                write = (idx == n - 1) & (t >= n - 1)
+                outs = lax.cond(
+                    write,
+                    lambda o: lax.dynamic_update_index_in_dim(
+                        o, y, slot, 0),
+                    lambda o: o, outs)
+                return y, outs
+
+            # permute at the top of steps 1..T-1 (no discarded rotation)
+            total = m_count + n - 1
+            y, outs = compute(0, state, outs)
+
+            def step(carry, t):
+                y_prev, outs = carry
+                state = lax.ppermute(y_prev, "pp", perm)
+                y, outs = compute(t, state, outs)
+                return (y, outs), None
+
+            if total > 1:
+                (y, outs), _ = lax.scan(step, (y, outs),
+                                        jnp.arange(1, total))
+            outs = lax.psum(
+                jnp.where(idx == n - 1, outs, jnp.zeros_like(outs)), "pp")
+            return outs
+
+        from jax import shard_map
+        blk_specs = jax.tree_util.tree_map(lambda _: P("pp"),
+                                           blocks)
+        out_mb = shard_map(
+            pp_body, mesh=mesh, axis_names={"pp"},
+            in_specs=(blk_specs, P(None)), out_specs=P(None))(blocks, mb)
+        x = out_mb.reshape((b, s, cfg.hidden_size))
+    else:
+        x = _stack_apply(blocks, x, cfg, pcfg, mesh)
+
+    x = _layer_norm(x, params["lnf_g"].astype(cdt),
+                    params["lnf_b"].astype(cdt))
+    logits = jnp.einsum("bsh,vh->bsv", x, params["wte"].astype(cdt))
+    return logits
+
+
+def loss_fn(params, batch, cfg, pcfg, mesh):
+    input_ids, labels = batch
+    logits = forward(params, input_ids, cfg, pcfg, mesh)
+    logits = logits[:, :-1].astype(jnp.float32)
+    tgt = labels[:, 1:]
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, tgt[..., None],
+                                 axis=-1)[..., 0]
+    return jnp.mean(logz - picked)
+
+
+# --------------------------- optimizer -------------------------------------
+def adamw_init(params, pcfg, mesh, specs):
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    if pcfg.zero1 and pcfg.dp > 1:
+        # ZeRO-1: moments sharded over dp on their largest dim
+        def shard_moment(x, s):
+            entry = list(tuple(s)) + [None] * (x.ndim - len(tuple(s)))
+            if "dp" not in jax.tree_util.tree_leaves(entry):
+                dims = [i for i, e in enumerate(entry) if e is None
+                        and x.shape[i] % pcfg.dp == 0]
+                if dims:
+                    entry[dims[0]] = "dp"
+            return jax.device_put(x, NamedSharding(mesh, P(*entry)))
+        zeros = jax.tree_util.tree_map(shard_moment, zeros, specs)
+    return {"m": zeros,
+            "v": jax.tree_util.tree_map(jnp.zeros_like, zeros),
+            "step": jnp.zeros((), jnp.int32)}
+
+
+def adamw_update(params, grads, opt_state, lr=3e-4, b1=0.9, b2=0.95,
+                 eps=1e-8, wd=0.1):
+    step = opt_state["step"] + 1
+    sf = step.astype(jnp.float32)
+    c1 = 1 - b1 ** sf
+    c2 = 1 - b2 ** sf
+
+    def upd(p, g, m, v):
+        g = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        m_new = b1 * m.astype(jnp.float32) + (1 - b1) * g
+        v_new = b2 * v.astype(jnp.float32) + (1 - b2) * g * g
+        update = (m_new / c1) / (jnp.sqrt(v_new / c2) + eps) + wd * pf
+        return ((pf - lr * update).astype(p.dtype),
+                m_new.astype(m.dtype), v_new.astype(v.dtype))
+
+    flat_p, tdef = jax.tree_util.tree_flatten(params)
+    flat_g = jax.tree_util.tree_leaves(grads)
+    flat_m = jax.tree_util.tree_leaves(opt_state["m"])
+    flat_v = jax.tree_util.tree_leaves(opt_state["v"])
+    out = [upd(p, g, m, v) for p, g, m, v
+           in zip(flat_p, flat_g, flat_m, flat_v)]
+    new_p = jax.tree_util.tree_unflatten(tdef, [o[0] for o in out])
+    new_m = jax.tree_util.tree_unflatten(tdef, [o[1] for o in out])
+    new_v = jax.tree_util.tree_unflatten(tdef, [o[2] for o in out])
+    return new_p, {"m": new_m, "v": new_v, "step": step}
+
+
+# --------------------------- train step ------------------------------------
+def build_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
+                     lr=3e-4):
+    def train_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, batch, cfg, pcfg, mesh))(params)
+        new_params, new_opt = adamw_update(params, grads, opt_state, lr=lr)
+        return new_params, new_opt, loss
+
+    return jax.jit(train_step, donate_argnums=(0, 1))
+
+
+def setup(cfg: GPTConfig, pcfg: ParallelConfig, seed=0, devices=None):
+    """Returns (mesh, params, opt_state, train_step)."""
+    mesh = build_mesh(pcfg, devices)
+    key = jax.random.PRNGKey(seed)
+    params = init_params(cfg, pcfg, key)
+    with mesh:
+        params, specs = shard_params(params, mesh, cfg, pcfg)
+        opt_state = adamw_init(params, pcfg, mesh, specs)
+    step_fn = build_train_step(cfg, pcfg, mesh, lr=3e-4)
+    return mesh, params, opt_state, step_fn

@@ -45,14 +45,13 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_microbatches,
     total = m + n - 1
 
     my_params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
-    state = lax.pvary(jnp.zeros_like(x_microbatches[0]), (axis_name,))
-    outputs = lax.pvary(
+    state = lax.pcast(jnp.zeros_like(x_microbatches[0]), (axis_name,), to='varying')
+    outputs = lax.pcast(
         jnp.zeros((m,) + x_microbatches.shape[1:], x_microbatches.dtype),
-        (axis_name,))
+        (axis_name,), to='varying')
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def step(carry, t):
-        state, outputs = carry
+    def compute(t, state, outputs):
         # stage 0 ingests microbatch t (when available); others take the
         # activation that just arrived from the previous stage
         mb = x_microbatches[jnp.clip(t, 0, m - 1)]
@@ -65,12 +64,21 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_microbatches,
             write,
             lambda o: lax.dynamic_update_index_in_dim(o, y, out_slot, 0),
             lambda o: o, outputs)
-        # rotate activations one stage forward
-        state = lax.ppermute(y, axis_name, perm)
-        return (state, outputs), None
+        return y, outputs
 
-    (state, outputs), _ = lax.scan(step, (state, outputs),
-                                   jnp.arange(total))
+    # permute at the TOP of steps 1..total-1 so the final (discarded)
+    # rotation is never issued
+    y, outputs = compute(0, state, outputs)
+
+    def step(carry, t):
+        y_prev, outputs = carry
+        state = lax.ppermute(y_prev, axis_name, perm)
+        y, outputs = compute(t, state, outputs)
+        return (y, outputs), None
+
+    if total > 1:
+        (y, outputs), _ = lax.scan(step, (y, outputs),
+                                   jnp.arange(1, total))
     # broadcast last stage's outputs to all pp ranks (so loss is computable
     # everywhere; on hardware this is one ICI allgather of the logits)
     outputs = lax.psum(

@@ -54,31 +54,31 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
 
     # running flash-softmax state (f32); pvary marks the fresh buffers as
     # device-varying so the scan carry type matches its outputs
-    acc = lax.pvary(jnp.zeros((b, sq, h, d), jnp.float32), (axis_name,))
-    m_run = lax.pvary(jnp.full((b, h, sq), -jnp.inf, jnp.float32),
-                      (axis_name,))
-    l_run = lax.pvary(jnp.zeros((b, h, sq), jnp.float32), (axis_name,))
+    acc = lax.pcast(jnp.zeros((b, sq, h, d), jnp.float32), (axis_name,), to='varying')
+    m_run = lax.pcast(jnp.full((b, h, sq), -jnp.inf, jnp.float32),
+                      (axis_name,), to='varying')
+    l_run = lax.pcast(jnp.zeros((b, h, sq), jnp.float32), (axis_name,), to='varying')
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def step(carry, t):
-        k_cur, v_cur, acc, m_run, l_run = carry
+    def _mask_for(src):
+        if not causal:
+            return None
+        # global block order: q-block my_idx attends kv-block src iff
+        # src <= my_idx; equal block → triangular mask
+        iq = lax.broadcasted_iota(jnp.int32, (sq, sq), 0)
+        ik = lax.broadcasted_iota(jnp.int32, (sq, sq), 1)
+        tri = iq >= ik
+        full = jnp.ones((sq, sq), bool)
+        empty = jnp.zeros((sq, sq), bool)
+        return jnp.where(src < my_idx, full,
+                         jnp.where(src == my_idx, tri, empty))
+
+    def _merge(acc, m_run, l_run, k_cur, v_cur, t):
         # k_cur originated on device (my_idx - t) mod n
         src = (my_idx - t) % n
-        if causal:
-            # global block order: q-block my_idx attends kv-block src iff
-            # src <= my_idx; equal block → triangular mask
-            iq = lax.broadcasted_iota(jnp.int32, (sq, sq), 0)
-            ik = lax.broadcasted_iota(jnp.int32, (sq, sq), 1)
-            tri = iq >= ik
-            full = jnp.ones((sq, sq), bool)
-            empty = jnp.zeros((sq, sq), bool)
-            mask = jnp.where(src < my_idx, full,
-                             jnp.where(src == my_idx, tri, empty))
-        else:
-            mask = None
-        m_blk, l_blk, o_blk = _block_attend(q, k_cur, v_cur, s, mask)
-        # merge running state
+        m_blk, l_blk, o_blk = _block_attend(q, k_cur, v_cur, s,
+                                            _mask_for(src))
         m_new = jnp.maximum(m_run, m_blk)
         # guard -inf - -inf
         safe = lambda x, mn: jnp.where(  # noqa: E731
@@ -88,13 +88,22 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
         l_new = alpha * l_run + beta * l_blk
         acc = acc * jnp.moveaxis(alpha, 1, 2)[..., None] \
             + o_blk * jnp.moveaxis(beta, 1, 2)[..., None]
-        # rotate kv around the ring (skip on last step)
-        k_nxt = lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return (k_nxt, v_nxt, acc, m_new, l_new), None
+        return acc, m_new, l_new
 
-    (k_f, v_f, acc, m_run, l_run), _ = lax.scan(
-        step, (k, v, acc, m_run, l_run), jnp.arange(n))
+    # local block first, then n-1 rotations: permute at the TOP of each
+    # scan step so no discarded final rotation is issued
+    acc, m_run, l_run = _merge(acc, m_run, l_run, k, v, 0)
+
+    def step(carry, t):
+        k_cur, v_cur, acc, m_run, l_run = carry
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        acc, m_run, l_run = _merge(acc, m_run, l_run, k_cur, v_cur, t)
+        return (k_cur, v_cur, acc, m_run, l_run), None
+
+    if n > 1:
+        (k_f, v_f, acc, m_run, l_run), _ = lax.scan(
+            step, (k, v, acc, m_run, l_run), jnp.arange(1, n))
     denom = jnp.moveaxis(l_run, 1, 2)[..., None]
     out = acc / jnp.maximum(denom, 1e-30)
     return out.astype(q.dtype)

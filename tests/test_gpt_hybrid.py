@@ -1,0 +1,81 @@
+"""Hybrid-parallel GPT engine on the virtual 8-device mesh: every
+parallelism axis compiles and executes, and parallel losses match the
+single-device run (the reference's hybrid_strategy loss-parity tests,
+test/collective/fleet/hybrid_parallel_mp_model.py style)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models.gpt import GPTConfig
+from paddle_tpu.models.gpt_hybrid import (ParallelConfig, build_mesh,
+                                          init_params, setup, loss_fn,
+                                          shard_params)
+
+
+CFG = GPTConfig(vocab_size=64, hidden_size=32, num_layers=4, num_heads=4,
+                max_seq_len=16)
+
+
+def _batch(b=8, s=16):
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, CFG.vocab_size, (b, s)))
+    return ids, ids
+
+
+def _ref_loss():
+    pcfg = ParallelConfig(dp=1, pp=1, tp=1, param_dtype=jnp.float32,
+                          compute_dtype=jnp.float32, remat=False)
+    mesh = build_mesh(pcfg, jax.devices()[:1])
+    params = init_params(CFG, pcfg, jax.random.PRNGKey(0))
+    return float(loss_fn(params, _batch(), CFG, pcfg, mesh))
+
+
+@pytest.mark.parametrize("pcfg_kw", [
+    dict(dp=2, pp=1, tp=4),
+    dict(dp=2, pp=1, tp=4, sp=True),
+    dict(dp=1, pp=2, tp=2, microbatches=4),
+    dict(dp=2, pp=2, tp=2, sp=True, microbatches=2),
+])
+def test_hybrid_loss_parity(pcfg_kw):
+    ref = _ref_loss()
+    pcfg = ParallelConfig(param_dtype=jnp.float32,
+                          compute_dtype=jnp.float32, remat=False,
+                          **pcfg_kw)
+    mesh = build_mesh(pcfg)
+    params = init_params(CFG, pcfg, jax.random.PRNGKey(0))
+    with mesh:
+        params, _ = shard_params(params, mesh, CFG, pcfg)
+        loss = float(loss_fn(params, _batch(), CFG, pcfg, mesh))
+    np.testing.assert_allclose(loss, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_train_step_runs_and_decreases():
+    pcfg = ParallelConfig(dp=2, pp=2, tp=2, sp=True, microbatches=2,
+                          param_dtype=jnp.float32,
+                          compute_dtype=jnp.float32)
+    mesh, params, opt_state, step = setup(CFG, pcfg, seed=0)
+    batch = _batch()
+    with mesh:
+        losses = []
+        for _ in range(5):
+            params, opt_state, loss = step(params, opt_state, batch)
+            losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_moe_expert_parallel():
+    pcfg = ParallelConfig(dp=2, pp=1, tp=2, num_experts=4,
+                          param_dtype=jnp.float32,
+                          compute_dtype=jnp.float32)
+    mesh, params, opt_state, step = setup(CFG, pcfg, seed=0,
+                                          devices=jax.devices()[:4])
+    batch = _batch()
+    with mesh:
+        losses = []
+        for _ in range(5):
+            params, opt_state, loss = step(params, opt_state, batch)
+            losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
