@@ -55,7 +55,20 @@ from paddle_tpu.framework.io import save, load  # noqa: F401
 from paddle_tpu.nn.layer.layers import ParamAttr  # noqa: F401
 
 from paddle_tpu import distributed  # noqa: F401
+from paddle_tpu import metric  # noqa: F401
 import paddle_tpu.linalg as linalg  # noqa: F401
+
+# heavier namespaces load lazily
+_LAZY = {"vision", "hapi", "profiler", "static", "models", "parallel",
+         "incubate", "distribution", "sparse", "device", "inference",
+         "quantization", "utils", "text", "geometric"}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        return importlib.import_module(f"paddle_tpu.{name}")
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 import paddle_tpu.fft as fft  # noqa: F401
 import paddle_tpu.signal as signal  # noqa: F401
 

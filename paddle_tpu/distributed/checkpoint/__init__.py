@@ -1,0 +1,136 @@
+"""Distributed checkpoint (reference: paddle.distributed.checkpoint —
+save_state_dict (save_state_dict.py:145) writes per-rank shard files + a
+metadata file with dedup of replicated tensors; load_state_dict re-shards
+across changed meshes).
+
+TPU-native: each host writes only its addressable shards (npz) plus a JSON
+metadata mapping flat key → shard index-slices → file; load assembles the
+global value then device_puts to the *target* sharding, so resharding
+across different meshes falls out of placement (the reference needs an
+explicit re-shard pass). Async save offloads the host copy to a thread
+(orbax-style)."""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+import jax
+
+from paddle_tpu.core.tensor import Tensor
+
+
+def _process_index():
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def _flat(state_dict, prefix=""):
+    out = {}
+    for k, v in state_dict.items():
+        key = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_flat(v, key))
+        else:
+            out[key] = v
+    return out
+
+
+def save_state_dict(state_dict: Dict, path: str, process_group=None,
+                    coordinator_rank: int = 0, async_save: bool = False):
+    os.makedirs(path, exist_ok=True)
+    pid = _process_index()
+    flat = _flat(state_dict)
+    meta = {"version": 1, "tensors": {}}
+    arrays = {}
+    for key, t in flat.items():
+        if not isinstance(t, Tensor):
+            meta["tensors"][key] = {"kind": "python", "value": t}
+            continue
+        arr = t._data
+        sharding = getattr(arr, "sharding", None)
+        entries = []
+        if sharding is None or sharding.is_fully_replicated:
+            # dedup: only the coordinator writes replicated tensors
+            if pid == coordinator_rank:
+                name = f"{key}.full"
+                arrays[name] = np.asarray(arr)
+                entries.append({"file": f"shard_{pid}.npz", "name": name,
+                                "index": None})
+        else:
+            seen = set()
+            for shard in arr.addressable_shards:
+                idx = tuple(
+                    (s.start or 0,
+                     s.stop if s.stop is not None else dim)
+                    for s, dim in zip(shard.index, arr.shape))
+                if idx in seen:
+                    continue  # dedup replicated copies of the same slice
+                seen.add(idx)
+                name = f"{key}.{shard.replica_id}.{len(entries)}"
+                arrays[name] = np.asarray(shard.data)
+                entries.append({"file": f"shard_{pid}.npz", "name": name,
+                                "index": [list(i) for i in idx]})
+        meta["tensors"][key] = {
+            "kind": "tensor",
+            "shape": list(arr.shape),
+            "dtype": str(np.dtype(arr.dtype)),
+            "entries": entries,
+        }
+
+    def _write():
+        np.savez(os.path.join(path, f"shard_{pid}.npz"), **arrays)
+        if pid == coordinator_rank:
+            with open(os.path.join(path, "metadata.json"), "w") as f:
+                json.dump(meta, f)
+
+    if async_save:
+        th = threading.Thread(target=_write, daemon=True)
+        th.start()
+        return th
+    _write()
+
+
+def load_state_dict(state_dict: Dict, path: str, process_group=None,
+                    coordinator_rank: int = 0, offload: bool = False):
+    """Fill `state_dict`'s tensors in place, re-sharding to each target
+    tensor's current placement."""
+    with open(os.path.join(path, "metadata.json")) as f:
+        meta = json.load(f)
+    # lazy-load shard files
+    files: Dict[str, "np.lib.npyio.NpzFile"] = {}
+
+    def get_arr(file, name):
+        if file not in files:
+            files[file] = np.load(os.path.join(path, file))
+        return files[file][name]
+
+    flat = _flat(state_dict)
+    for key, t in flat.items():
+        info = meta["tensors"].get(key)
+        if info is None:
+            continue
+        if info["kind"] == "python":
+            continue
+        full = np.zeros(tuple(info["shape"]),
+                        np.dtype(info["dtype"]))
+        for e in info["entries"]:
+            arr = get_arr(e["file"], e["name"])
+            if e["index"] is None:
+                full = arr
+            else:
+                sl = tuple(slice(a, b) for a, b in e["index"])
+                full[sl] = arr
+        if isinstance(t, Tensor):
+            sharding = getattr(t._data, "sharding", None)
+            new = jax.device_put(full.astype(t._data.dtype), sharding) \
+                if sharding is not None else \
+                jax.numpy.asarray(full.astype(t._data.dtype))
+            t._assign_array(new)
+    for f in files.values():
+        f.close()
+    return state_dict

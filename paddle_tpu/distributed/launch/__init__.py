@@ -1,0 +1,12 @@
+"""Launcher (reference: python -m paddle.distributed.launch,
+launch/main.py:23 — Job/Pod/Container model, HTTP/etcd rendezvous,
+log capture).
+
+TPU-native: one controller process per HOST (JAX single-controller owns
+all local chips), so --devices fans out to one process per host, not per
+chip; rendezvous is the JAX coordination service (rank-0 host:port).
+Single-host multi-"rank" CPU simulation is supported for tests via
+--nproc_per_node with JAX_PLATFORMS=cpu (the reference's fake-cluster
+trick, SURVEY §4.2).
+"""
+from .main import main  # noqa: F401

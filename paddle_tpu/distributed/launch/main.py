@@ -1,0 +1,87 @@
+"""`python -m paddle_tpu.distributed.launch [--opts] script.py args...`"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _parse():
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--master", default=None,
+                   help="rank0 coordinator host:port")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--rank", type=int, default=0,
+                   help="this node's rank (multi-host)")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes on this host (CPU-sim testing; on TPU "
+                        "keep 1 — a single controller drives all chips)")
+    p.add_argument("--devices", default=None,
+                   help="accepted for reference-CLI parity")
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--job_id", default="default")
+    p.add_argument("script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args()
+
+
+def main():
+    args = _parse()
+    nprocs = args.nproc_per_node
+    world = args.nnodes * nprocs
+    master = args.master or "127.0.0.1:8476"
+    procs = []
+    log_dir = args.log_dir
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+    for local in range(nprocs):
+        rank = args.rank * nprocs + local
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_MASTER": master,
+            "PADDLE_LOCAL_RANK": str(local),
+            "PADDLE_JOB_ID": args.job_id,
+        })
+        cmd = [sys.executable, args.script] + args.script_args
+        stdout = open(os.path.join(log_dir, f"worker.{rank}.log"), "w") \
+            if log_dir else None
+        procs.append((rank, subprocess.Popen(
+            cmd, env=env, stdout=stdout,
+            stderr=subprocess.STDOUT if stdout else None)))
+    code = 0
+
+    def _kill_all(*_):
+        for _, p in procs:
+            if p.poll() is None:
+                p.terminate()
+
+    signal.signal(signal.SIGTERM, _kill_all)
+    try:
+        while procs:
+            alive = []
+            for rank, p in procs:
+                ret = p.poll()
+                if ret is None:
+                    alive.append((rank, p))
+                elif ret != 0:
+                    print(f"[launch] worker {rank} exited with {ret}; "
+                          "terminating job", file=sys.stderr)
+                    code = ret
+                    _kill_all()
+                    alive = []
+                    break
+            procs = alive
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        _kill_all()
+        code = 130
+    sys.exit(code)
+
+
+if __name__ == "__main__":
+    main()

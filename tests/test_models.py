@@ -1,0 +1,145 @@
+"""Model zoo smoke + convergence tests (reference: test/book/ end-to-end
+convergence + hybrid_strategy model scripts)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _train_steps(model, batch_fn, steps=8, lr=1e-2):
+    opt = paddle.optimizer.AdamW(lr, parameters=model.parameters())
+    losses = []
+    for i in range(steps):
+        loss = model(*batch_fn(i))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    return losses
+
+
+def test_gpt_tiny_trains():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(0)
+    m = GPTForCausalLM(GPTConfig.tiny())
+    ids = paddle.randint(0, 256, [4, 32])
+    losses = _train_steps(m, lambda i: (ids, ids), steps=8)
+    assert losses[-1] < losses[0]
+
+
+def test_llama_tiny_trains_and_generates():
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    paddle.seed(0)
+    m = LlamaForCausalLM(LlamaConfig.tiny())
+    ids = paddle.randint(0, 256, [4, 32])
+    losses = _train_steps(m, lambda i: (ids, ids), steps=8)
+    assert losses[-1] < losses[0]
+    out = m.generate(ids[:1, :8], max_new_tokens=4)
+    assert out.shape == [1, 8 + 4 + 1] or out.shape[1] >= 12
+
+
+def test_llama_gqa_kv_cache_matches_full_forward():
+    from paddle_tpu.models.llama import LlamaConfig, LlamaModel
+    paddle.seed(0)
+    m = LlamaModel(LlamaConfig.tiny())
+    m.eval()
+    ids = paddle.randint(0, 256, [2, 12])
+    full = m(ids)
+    caches = m.init_cache(2)
+    logits1, caches = m(ids[:, :8], 0, caches)
+    logits2, caches = m(ids[:, 8:], 8, caches)
+    np.testing.assert_allclose(full[:, :8].numpy(), logits1.numpy(),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(full[:, 8:].numpy(), logits2.numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_bert_mlm_trains():
+    from paddle_tpu.models.bert import BertConfig, BertForMaskedLM
+    paddle.seed(0)
+    m = BertForMaskedLM(BertConfig.tiny())
+    ids = paddle.randint(0, 256, [4, 16])
+    labels = ids.clone()
+    losses = _train_steps(m, lambda i: (ids, None, None, labels), steps=8)
+    assert losses[-1] < losses[0]
+
+
+def test_bert_amp_o2():
+    from paddle_tpu.models.bert import BertConfig, BertForMaskedLM
+    paddle.seed(0)
+    m = BertForMaskedLM(BertConfig.tiny())
+    opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+    m, opt = paddle.amp.decorate(m, opt, level="O2", dtype="bfloat16")
+    ids = paddle.randint(0, 256, [2, 16])
+    with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+        loss = m(ids, labels=ids)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    assert np.isfinite(float(loss))
+    # params stayed bf16; master weights fp32
+    assert m.bert.pooler.weight.dtype == paddle.bfloat16
+
+
+def test_moe_layer_trains():
+    from paddle_tpu.models.moe import MoELayer
+    paddle.seed(0)
+    layer = MoELayer(32, 64, num_experts=4, top_k=2)
+    head = nn.Linear(32, 8)
+    params = layer.parameters() + head.parameters()
+    opt = paddle.optimizer.Adam(1e-2, parameters=params)
+    x = paddle.randn([4, 8, 32])
+    y = paddle.randint(0, 8, [4, 8])
+    ce = nn.CrossEntropyLoss()
+    losses = []
+    for _ in range(8):
+        out = head(layer(x))
+        loss = ce(out.reshape([-1, 8]), y.reshape([-1])) \
+            + 0.01 * paddle.to_tensor(layer.aux_loss)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_resnet18_fakedata_trains():
+    from paddle_tpu.vision.models import resnet18
+    from paddle_tpu.vision.datasets import FakeData
+    from paddle_tpu.io import DataLoader
+    paddle.seed(0)
+    model = resnet18(num_classes=10)
+    opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
+    ce = nn.CrossEntropyLoss()
+    loader = DataLoader(FakeData(size=16, image_shape=(3, 32, 32)),
+                        batch_size=8)
+    losses = []
+    for epoch in range(4):
+        for img, label in loader:
+            loss = ce(model(img), label)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_vision_model_shapes():
+    from paddle_tpu.vision.models import LeNet, mobilenet_v2, vgg11
+    x = paddle.randn([1, 3, 64, 64])
+    assert vgg11(num_classes=7)(
+        paddle.randn([1, 3, 224, 224])).shape == [1, 7]
+    assert mobilenet_v2(num_classes=5)(x).shape == [1, 5]
+    assert LeNet()(paddle.randn([1, 1, 28, 28])).shape == [1, 10]
+
+
+def test_transforms_pipeline():
+    from paddle_tpu.vision import transforms as T
+    t = T.Compose([T.Resize(40), T.RandomCrop(32),
+                   T.RandomHorizontalFlip(), T.ToTensor(),
+                   T.Normalize([0.5, 0.5, 0.5], [0.5, 0.5, 0.5])])
+    img = (np.random.rand(48, 48, 3) * 255).astype(np.uint8)
+    out = t(img)
+    assert out.shape == [3, 32, 32]
+    assert out.dtype == paddle.float32
