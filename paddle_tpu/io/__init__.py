@@ -247,12 +247,23 @@ class DistributedBatchSampler(BatchSampler):
 
 
 # ------------------------------ collation ----------------------------------
+def _stack(arrs):
+    # native threaded collation when available (C++ DataFeed analog)
+    try:
+        from paddle_tpu import native
+        if native.available() and len(arrs) > 1 and arrs[0].nbytes > 4096:
+            return native.collate(arrs)
+    except Exception:
+        pass
+    return np.stack(arrs)
+
+
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, Tensor):
-        return Tensor._wrap(np.stack([np.asarray(s._data) for s in batch]))
+        return Tensor(_stack([np.asarray(s._data) for s in batch]))
     if isinstance(sample, np.ndarray):
-        return Tensor(np.stack(batch))
+        return Tensor(_stack(batch))
     if isinstance(sample, (int, np.integer)):
         return Tensor(np.asarray(batch, np.int64))
     if isinstance(sample, (float, np.floating)):

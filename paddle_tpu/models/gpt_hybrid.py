@@ -168,13 +168,19 @@ def _attend(q, k, v, nh):
     q = q.reshape(b, s, nh, d)
     k = k.reshape(b, s, nh, d)
     v = v.reshape(b, s, nh, d)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                        preferred_element_type=jnp.float32) / math.sqrt(d)
-    iq = lax.broadcasted_iota(jnp.int32, (s, s), 0)
-    ik = lax.broadcasted_iota(jnp.int32, (s, s), 1)
-    logits = jnp.where((iq >= ik)[None, None], logits, -1e30)
-    p = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    # Pallas flash kernel on TPU (phi flash_attn_kernel.cu analog);
+    # XLA einsum attention elsewhere
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_maybe
+    out = flash_attention_maybe(q, k, v, causal=True)
+    if out is None:
+        logits = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k,
+            preferred_element_type=jnp.float32) / math.sqrt(d)
+        iq = lax.broadcasted_iota(jnp.int32, (s, s), 0)
+        ik = lax.broadcasted_iota(jnp.int32, (s, s), 1)
+        logits = jnp.where((iq >= ik)[None, None], logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
     return out.reshape(b, s, h)
 
 

@@ -1,0 +1,168 @@
+"""Native host runtime bindings (ctypes over paddle_native.cc).
+
+Builds the shared library on first use with g++ (cached next to the
+source); all entry points degrade gracefully to numpy when the toolchain
+or library is unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src", "paddle_native.cc")
+_SO = os.path.join(_DIR, "libpaddle_native.so")
+
+_lib = None
+_lock = threading.Lock()
+
+
+def _build():
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           _SRC, "-o", _SO]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def get_lib():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        try:
+            if not os.path.exists(_SO) or \
+                    os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+                _build()
+            lib = ctypes.CDLL(_SO)
+        except Exception:
+            return None
+        lib.pn_collate.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32]
+        lib.pn_u8hwc_to_f32chw_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_float,
+            ctypes.c_int32]
+        lib.pn_queue_create.restype = ctypes.c_void_p
+        lib.pn_queue_create.argtypes = [ctypes.c_int64]
+        lib.pn_queue_destroy.argtypes = [ctypes.c_void_p]
+        lib.pn_queue_close.argtypes = [ctypes.c_void_p]
+        lib.pn_queue_push.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                      ctypes.c_int64]
+        lib.pn_queue_push.restype = ctypes.c_int32
+        lib.pn_queue_next_size.argtypes = [ctypes.c_void_p]
+        lib.pn_queue_next_size.restype = ctypes.c_int64
+        lib.pn_queue_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                     ctypes.c_int64]
+        lib.pn_queue_pop.restype = ctypes.c_int64
+        lib.pn_queue_size.argtypes = [ctypes.c_void_p]
+        lib.pn_queue_size.restype = ctypes.c_int64
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+# --------------------------------------------------------------------------
+def collate(samples, nthreads=4):
+    """Stack equally-shaped contiguous np arrays into a batch (parallel
+    native memcpy; numpy fallback)."""
+    arrs = [np.ascontiguousarray(s) for s in samples]
+    lib = get_lib()
+    first = arrs[0]
+    if lib is None or any(a.shape != first.shape or a.dtype != first.dtype
+                          for a in arrs):
+        return np.stack(arrs)
+    out = np.empty((len(arrs),) + first.shape, first.dtype)
+    ptrs = (ctypes.c_void_p * len(arrs))(
+        *[a.ctypes.data_as(ctypes.c_void_p) for a in arrs])
+    lib.pn_collate(ptrs, len(arrs), out.ctypes.data_as(ctypes.c_void_p),
+                   first.nbytes, nthreads)
+    return out
+
+
+def u8hwc_to_f32chw_batch(images, mean, std, scale=1.0 / 255.0,
+                          nthreads=4):
+    """Fused ToTensor+Normalize+Transpose over a batch of uint8 HWC
+    images -> float32 [N, C, H, W]."""
+    arrs = [np.ascontiguousarray(im, np.uint8) for im in images]
+    h, w, c = arrs[0].shape
+    mean = np.ascontiguousarray(mean, np.float32)
+    std = np.ascontiguousarray(std, np.float32)
+    lib = get_lib()
+    if lib is None:
+        batch = np.stack(arrs).astype(np.float32) * scale
+        batch = (batch - mean.reshape(1, 1, 1, -1)) / std.reshape(
+            1, 1, 1, -1)
+        return batch.transpose(0, 3, 1, 2).copy()
+    out = np.empty((len(arrs), c, h, w), np.float32)
+    ptrs = (ctypes.c_void_p * len(arrs))(
+        *[a.ctypes.data_as(ctypes.c_void_p) for a in arrs])
+    lib.pn_u8hwc_to_f32chw_batch(
+        ptrs, out.ctypes.data_as(ctypes.c_void_p), len(arrs), h, w, c,
+        mean.ctypes.data_as(ctypes.c_void_p),
+        std.ctypes.data_as(ctypes.c_void_p), scale, nthreads)
+    return out
+
+
+class BlockingQueue:
+    """Native condvar blocking queue for byte blobs
+    (LoDTensorBlockingQueue analog). Fallback: queue.Queue."""
+
+    def __init__(self, capacity=8):
+        lib = get_lib()
+        self._lib = lib
+        if lib is None:
+            import queue
+            self._q = queue.Queue(maxsize=capacity)
+            self._handle = None
+        else:
+            self._handle = ctypes.c_void_p(lib.pn_queue_create(capacity))
+
+    def push(self, data: bytes) -> bool:
+        if self._handle is None:
+            self._q.put(data)
+            return True
+        buf = (ctypes.c_char * len(data)).from_buffer_copy(data)
+        return bool(self._lib.pn_queue_push(self._handle, buf, len(data)))
+
+    def pop(self):
+        """bytes, or None at end-of-stream (closed + drained)."""
+        if self._handle is None:
+            item = self._q.get()
+            return item
+        size = self._lib.pn_queue_next_size(self._handle)
+        if size < 0:
+            return None
+        out = ctypes.create_string_buffer(size)
+        got = self._lib.pn_queue_pop(self._handle, out, size)
+        if got < 0:
+            return None
+        return out.raw[:got]
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.pn_queue_close(self._handle)
+
+    def __len__(self):
+        if self._handle is None:
+            return self._q.qsize()
+        return self._lib.pn_queue_size(self._handle)
+
+    def __del__(self):
+        try:
+            if self._handle is not None:
+                self._lib.pn_queue_close(self._handle)
+                self._lib.pn_queue_destroy(self._handle)
+                self._handle = None
+        except Exception:
+            pass

@@ -1,0 +1,63 @@
+"""Flash attention for TPU (Pallas).
+
+Reference being replaced: phi/kernels/gpu/flash_attn_kernel.cu:587 (CUDA
+flash-attention v2 wrapper). TPU-native: the Pallas TPU flash kernel
+shipped with JAX (jax.experimental.pallas.ops.tpu.flash_attention) —
+blockwise streaming-softmax in VMEM with custom fwd+bwd kernels tuned for
+the MXU. This module adapts it to the paddle layout [B, S, H, D] and
+applies the shape gating (seq % block == 0, head_dim tile-friendly).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _supported(q, k, v):
+    if q.dtype not in (jnp.float32, jnp.bfloat16):
+        return False
+    b, s, h, d = q.shape
+    if s % 128 != 0 or k.shape[1] % 128 != 0:
+        return False
+    if d % 64 != 0:
+        return False
+    return True
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None):
+    """q/k/v: [B, S, H, D] (paddle flash-attn layout) -> [B, S, H, D]."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes, flash_attention as _fa)
+    d = q.shape[-1]
+    sm_scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    # kernel layout is [B, H, S, D]
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    s_q, s_k = qt.shape[2], kt.shape[2]
+    blk = BlockSizes(
+        block_q=min(512, s_q), block_k_major=min(512, s_k),
+        block_k=min(512, s_k), block_b=1,
+        block_q_major_dkv=min(512, s_q), block_k_major_dkv=min(512, s_k),
+        block_k_dkv=min(512, s_k), block_q_dkv=min(512, s_q),
+        block_k_major_dq=min(512, s_k), block_k_dq=min(512, s_k),
+        block_q_dq=min(512, s_q))
+    out = _fa(qt, kt, vt, causal=causal, sm_scale=sm_scale,
+              block_sizes=blk)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def flash_attention_maybe(q, k, v, causal=False, scale=None):
+    """Pallas kernel when on TPU with supported shapes, else None."""
+    try:
+        if jax.default_backend() != "tpu":
+            return None
+        if not _supported(q, k, v):
+            return None
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    except Exception:
+        return None

@@ -1,0 +1,118 @@
+"""OpTest harness (reference: test/legacy_test/op_test.py:418 —
+check_output against a NumPy oracle in eager AND compiled modes,
+check_grad against finite-difference numeric gradients
+(get_numeric_gradient :148))."""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+class OpTest:
+    """Subclass and set:
+      op:        callable taking Tensors (the paddle_tpu op)
+      ref:       callable taking numpy arrays (oracle)
+      inputs:    dict name -> np.ndarray
+      attrs:     extra kwargs for both
+      grad_inputs: names to grad-check (default: all float inputs)
+    """
+
+    op: Callable = None
+    ref: Callable = None
+    inputs: Dict[str, np.ndarray] = {}
+    attrs: Dict = {}
+    rtol = 1e-5
+    atol = 1e-6
+    grad_rtol = 5e-2
+    grad_atol = 5e-3
+    fd_eps = 1e-3
+
+    # ------------------------------------------------------------------
+    def _tensors(self, stop_gradient=True):
+        return {k: paddle.to_tensor(v, stop_gradient=stop_gradient)
+                for k, v in self.inputs.items()}
+
+    def _run_op(self, tensors):
+        return type(self).op(*tensors.values(), **self.attrs)
+
+    def check_output(self, compiled=True):
+        # eager
+        out = self._run_op(self._tensors())
+        ref_out = type(self).ref(*[np.asarray(v)
+                                   for v in self.inputs.values()],
+                                 **self.attrs)
+        self._compare(out, ref_out, "eager")
+        if compiled:
+            op = type(self).op
+            attrs = self.attrs
+            names = list(self.inputs)
+
+            def fn(*ts):
+                return op(*ts, **attrs)
+            static_fn = paddle.jit.to_static(fn, objs=[])
+            out_c = static_fn(*self._tensors().values())
+            self._compare(out_c, ref_out, "compiled")
+
+    def _compare(self, out, ref_out, mode):
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        refs = ref_out if isinstance(ref_out, (tuple, list)) else [ref_out]
+        for i, (o, r) in enumerate(zip(outs, refs)):
+            np.testing.assert_allclose(
+                np.asarray(o.numpy(), np.float64)
+                if o.dtype != np.bool_ else o.numpy(),
+                np.asarray(r, np.float64)
+                if np.asarray(r).dtype != np.bool_ else r,
+                rtol=self.rtol, atol=self.atol,
+                err_msg=f"{mode} output {i} mismatch")
+
+    # ------------------------------------------------------------------
+    def check_grad(self, grad_inputs: Sequence[str] = None,
+                   output_index=0):
+        names = list(grad_inputs or
+                     [k for k, v in self.inputs.items()
+                      if np.issubdtype(np.asarray(v).dtype, np.floating)])
+        tensors = self._tensors(stop_gradient=False)
+        for k in tensors:
+            tensors[k].stop_gradient = k not in names
+        out = self._run_op(tensors)
+        out0 = (out[output_index]
+                if isinstance(out, (tuple, list)) else out)
+        out0.sum().backward()
+        for name in names:
+            analytic = tensors[name].grad.numpy().astype(np.float64)
+            numeric = self._numeric_grad(name, output_index)
+            np.testing.assert_allclose(
+                analytic, numeric, rtol=self.grad_rtol,
+                atol=self.grad_atol,
+                err_msg=f"grad mismatch for input {name!r}")
+
+    def _numeric_grad(self, name, output_index):
+        """central finite differences of sum(op(...)[output_index])."""
+        base = {k: np.asarray(v, np.float64).copy()
+                for k, v in self.inputs.items()}
+        x = base[name]
+        grad = np.zeros_like(x)
+
+        def f(vals):
+            ts = {k: paddle.to_tensor(v.astype(self.inputs[k].dtype))
+                  for k, v in vals.items()}
+            out = self._run_op(ts)
+            o = out[output_index] if isinstance(out, (tuple, list)) else out
+            return float(np.asarray(o.numpy(), np.float64).sum())
+
+        flat = x.reshape(-1)
+        gflat = grad.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + self.fd_eps
+            fp = f(base)
+            flat[i] = orig - self.fd_eps
+            fm = f(base)
+            flat[i] = orig
+            gflat[i] = (fp - fm) / (2 * self.fd_eps)
+        return grad

@@ -1,0 +1,90 @@
+"""Native C++ runtime library tests (collation, fused image transform,
+blocking queue)."""
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu import native
+
+
+@pytest.fixture(scope="module")
+def lib():
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    return native.get_lib()
+
+
+def test_collate_matches_numpy(lib):
+    rng = np.random.RandomState(0)
+    samples = [rng.randn(3, 32, 32).astype(np.float32) for _ in range(16)]
+    out = native.collate(samples)
+    np.testing.assert_array_equal(out, np.stack(samples))
+
+
+def test_fused_image_transform(lib):
+    rng = np.random.RandomState(0)
+    imgs = [rng.randint(0, 256, (8, 6, 3), dtype=np.uint8)
+            for _ in range(5)]
+    mean = np.array([0.48, 0.45, 0.4], np.float32)
+    std = np.array([0.22, 0.22, 0.22], np.float32)
+    out = native.u8hwc_to_f32chw_batch(imgs, mean, std)
+    ref = (np.stack(imgs).astype(np.float32) / 255.0
+           - mean.reshape(1, 1, 1, 3)) / std.reshape(1, 1, 1, 3)
+    ref = ref.transpose(0, 3, 1, 2)
+    np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_blocking_queue_producer_consumer(lib):
+    q = native.BlockingQueue(capacity=4)
+    items = [bytes([i]) * (i + 1) for i in range(20)]
+    got = []
+
+    def producer():
+        for it in items:
+            assert q.push(it)
+        q.close()
+
+    t = threading.Thread(target=producer)
+    t.start()
+    while True:
+        item = q.pop()
+        if item is None:
+            break
+        got.append(item)
+    t.join()
+    assert got == items
+
+
+def test_queue_blocks_when_full(lib):
+    q = native.BlockingQueue(capacity=2)
+    assert q.push(b"a") and q.push(b"b")
+    state = {"pushed": False}
+
+    def slow_push():
+        q.push(b"c")
+        state["pushed"] = True
+
+    t = threading.Thread(target=slow_push)
+    t.start()
+    t.join(timeout=0.2)
+    assert not state["pushed"]  # still blocked on full queue
+    assert q.pop() == b"a"
+    t.join(timeout=2)
+    assert state["pushed"]
+    q.close()
+
+
+def test_dataloader_uses_native_collate():
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class DS(Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return np.full((64, 64), i, np.float32), np.int64(i)
+
+    batches = list(DataLoader(DS(), batch_size=4))
+    assert batches[0][0].shape == [4, 64, 64]
+    assert float(batches[0][0][1, 0, 0]) == 1.0

@@ -1,0 +1,285 @@
+"""Single-op numeric-oracle + finite-difference grad tests through the
+OpTest harness (reference mechanism: test/legacy_test/op_test.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+
+from op_test import OpTest
+
+rng = np.random.RandomState(42)
+
+
+class TestMatmul(OpTest):
+    op = staticmethod(paddle.matmul)
+    ref = staticmethod(lambda a, b: a @ b)
+    inputs = {"x": rng.randn(4, 6).astype(np.float32),
+              "y": rng.randn(6, 3).astype(np.float32)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestExp(OpTest):
+    op = staticmethod(paddle.exp)
+    ref = staticmethod(np.exp)
+    inputs = {"x": rng.randn(3, 4).astype(np.float32)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestSoftmax(OpTest):
+    op = staticmethod(F.softmax)
+    inputs = {"x": rng.randn(3, 8).astype(np.float32)}
+
+    @staticmethod
+    def ref(x):
+        e = np.exp(x - x.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestLogSumExp(OpTest):
+    op = staticmethod(paddle.logsumexp)
+    inputs = {"x": rng.randn(4, 5).astype(np.float32)}
+
+    @staticmethod
+    def ref(x):
+        m = x.max()
+        return m + np.log(np.exp(x - m).sum())
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestTanh(OpTest):
+    op = staticmethod(paddle.tanh)
+    ref = staticmethod(np.tanh)
+    inputs = {"x": rng.randn(5,).astype(np.float32)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestSigmoidGrad(OpTest):
+    op = staticmethod(paddle.sigmoid)
+    ref = staticmethod(lambda x: 1 / (1 + np.exp(-x)))
+    inputs = {"x": rng.randn(4, 4).astype(np.float32)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestMeanAxis(OpTest):
+    op = staticmethod(paddle.mean)
+    ref = staticmethod(lambda x, axis=1, keepdim=True:
+                       x.mean(axis=axis, keepdims=keepdim))
+    inputs = {"x": rng.randn(3, 5).astype(np.float32)}
+    attrs = {"axis": 1, "keepdim": True}
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestConcat(OpTest):
+    inputs = {"x": rng.randn(2, 3).astype(np.float32),
+              "y": rng.randn(2, 3).astype(np.float32)}
+
+    @staticmethod
+    def op(x, y):
+        return paddle.concat([x, y], axis=1)
+
+    @staticmethod
+    def ref(x, y):
+        return np.concatenate([x, y], 1)
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestGather(OpTest):
+    inputs = {"x": rng.randn(6, 4).astype(np.float32),
+              "idx": np.array([0, 3, 5], np.int64)}
+
+    @staticmethod
+    def op(x, idx):
+        return paddle.gather(x, idx, axis=0)
+
+    @staticmethod
+    def ref(x, idx):
+        return x[idx]
+
+    def test(self):
+        self.check_output()
+        self.check_grad(grad_inputs=["x"])
+
+
+class TestLayerNorm(OpTest):
+    inputs = {"x": rng.randn(4, 8).astype(np.float32),
+              "g": rng.rand(8).astype(np.float32) + 0.5,
+              "b": rng.randn(8).astype(np.float32)}
+
+    @staticmethod
+    def op(x, g, b):
+        return F.layer_norm(x, 8, g, b)
+
+    @staticmethod
+    def ref(x, g, b):
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        return (x - mu) / np.sqrt(var + 1e-5) * g + b
+
+    rtol = 1e-4
+    atol = 1e-5
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestCrossEntropy(OpTest):
+    inputs = {"logits": rng.randn(6, 5).astype(np.float32),
+              "label": rng.randint(0, 5, (6,)).astype(np.int64)}
+
+    @staticmethod
+    def op(logits, label):
+        return F.cross_entropy(logits, label)
+
+    @staticmethod
+    def ref(logits, label):
+        m = logits.max(-1, keepdims=True)
+        logp = logits - m - np.log(
+            np.exp(logits - m).sum(-1, keepdims=True))
+        return -logp[np.arange(len(label)), label].mean()
+
+    def test(self):
+        self.check_output()
+        self.check_grad(grad_inputs=["logits"])
+
+
+class TestConv2D(OpTest):
+    inputs = {"x": rng.randn(1, 2, 6, 6).astype(np.float32),
+              "w": rng.randn(3, 2, 3, 3).astype(np.float32)}
+    attrs = {"stride": 1, "padding": 1}
+    rtol = 1e-4
+    atol = 1e-5
+
+    @staticmethod
+    def op(x, w, stride=1, padding=1):
+        return F.conv2d(x, w, stride=stride, padding=padding)
+
+    @staticmethod
+    def ref(x, w, stride=1, padding=1):
+        n, ci, h, wd = x.shape
+        co, _, kh, kw = w.shape
+        xp = np.pad(x, ((0, 0), (0, 0), (padding, padding),
+                        (padding, padding)))
+        oh = (h + 2 * padding - kh) // stride + 1
+        ow = (wd + 2 * padding - kw) // stride + 1
+        out = np.zeros((n, co, oh, ow), np.float64)
+        for i in range(oh):
+            for j in range(ow):
+                patch = xp[:, :, i * stride:i * stride + kh,
+                           j * stride:j * stride + kw]
+                out[:, :, i, j] = np.einsum("ncij,ocij->no", patch, w)
+        return out
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestWhere(OpTest):
+    inputs = {"c": rng.rand(3, 4) > 0.5,
+              "x": rng.randn(3, 4).astype(np.float32),
+              "y": rng.randn(3, 4).astype(np.float32)}
+
+    @staticmethod
+    def op(c, x, y):
+        return paddle.where(c, x, y)
+
+    @staticmethod
+    def ref(c, x, y):
+        return np.where(c, x, y)
+
+    def test(self):
+        self.check_output()
+        self.check_grad(grad_inputs=["x", "y"])
+
+
+class TestRsqrt(OpTest):
+    op = staticmethod(paddle.rsqrt)
+    ref = staticmethod(lambda x: 1.0 / np.sqrt(x))
+    inputs = {"x": (rng.rand(4, 3) + 0.5).astype(np.float32)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestPow(OpTest):
+    op = staticmethod(lambda x: paddle.pow(x, 3.0))
+    ref = staticmethod(lambda x: x ** 3.0)
+    inputs = {"x": (rng.rand(3, 3) + 0.5).astype(np.float32)}
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestCumsum(OpTest):
+    op = staticmethod(paddle.cumsum)
+    ref = staticmethod(lambda x, axis=1: np.cumsum(x, axis))
+    inputs = {"x": rng.randn(3, 5).astype(np.float32)}
+    attrs = {"axis": 1}
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+class TestSplitStack(OpTest):
+    inputs = {"x": rng.randn(4, 6).astype(np.float32)}
+
+    @staticmethod
+    def op(x):
+        a, b, c = paddle.split(x, 3, axis=1)
+        return paddle.stack([a, b, c], axis=0)
+
+    @staticmethod
+    def ref(x):
+        return np.stack(np.split(x, 3, 1), 0)
+
+    def test(self):
+        self.check_output()
+        self.check_grad()
+
+
+def test_sdpa_matches_reference():
+    b, s, h, d = 2, 16, 2, 8
+    q = rng.randn(b, s, h, d).astype(np.float32)
+    k = rng.randn(b, s, h, d).astype(np.float32)
+    v = rng.randn(b, s, h, d).astype(np.float32)
+    out = F.scaled_dot_product_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        is_causal=True)
+    # numpy oracle
+    logits = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    mask = np.tril(np.ones((s, s), bool))
+    logits = np.where(mask, logits, -1e30)
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bkhd->bqhd", p, v)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-5)
