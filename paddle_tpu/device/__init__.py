@@ -1,0 +1,105 @@
+"""paddle.device equivalent: device selection + memory stats
+(reference: python/paddle/device + phi/core/memory/stats.cc surfaced as
+paddle.device.cuda.max_memory_allocated etc.)."""
+from __future__ import annotations
+
+import jax
+
+from paddle_tpu.core.place import (  # noqa: F401
+    CPUPlace, CUDAPlace, CustomPlace, Place, TPUPlace, get_device,
+    set_device, is_compiled_with_tpu,
+)
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()
+            if d.platform not in ("cpu", "gpu", "tpu")]
+
+
+def device_count(device_type=None):
+    if device_type is None:
+        return len(jax.devices())
+    try:
+        return len(jax.devices(device_type))
+    except RuntimeError:
+        return 0
+
+
+def synchronize(device=None):
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+def _mem_stats(device_id=0):
+    try:
+        devs = jax.devices()
+        d = devs[device_id % len(devs)]
+        return d.memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def max_memory_allocated(device=None):
+    return _mem_stats().get("peak_bytes_in_use", 0)
+
+
+def max_memory_reserved(device=None):
+    return _mem_stats().get("peak_pool_bytes", max_memory_allocated())
+
+
+def memory_allocated(device=None):
+    return _mem_stats().get("bytes_in_use", 0)
+
+
+def memory_reserved(device=None):
+    return _mem_stats().get("pool_bytes", memory_allocated())
+
+
+class cuda:
+    """Namespace parity for paddle.device.cuda (maps to the active
+    accelerator's stats)."""
+
+    device_count = staticmethod(lambda: device_count("gpu"))
+    synchronize = staticmethod(synchronize)
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    max_memory_reserved = staticmethod(max_memory_reserved)
+    memory_allocated = staticmethod(memory_allocated)
+    memory_reserved = staticmethod(memory_reserved)
+
+    @staticmethod
+    def empty_cache():
+        import gc
+        gc.collect()
+
+
+class tpu:
+    device_count = staticmethod(lambda: device_count("tpu"))
+    synchronize = staticmethod(synchronize)
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    memory_allocated = staticmethod(memory_allocated)
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_custom_device(device_type):
+    try:
+        return bool(jax.devices(device_type))
+    except RuntimeError:
+        return False

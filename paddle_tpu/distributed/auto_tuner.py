@@ -1,0 +1,90 @@
+"""Parallel-config auto-tuner (reference: distributed/auto_tuner/
+{prune,utils}.py — grid search with pruning over dp/mp/pp/micro-batch
+configs).
+
+TPU-native: candidates are (dp, pp, tp, microbatch) factorizations of the
+mesh; pruning uses memory/divisibility constraints; measurement jit-runs
+the actual train step a few times per candidate.
+"""
+from __future__ import annotations
+
+import itertools
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+
+@dataclass
+class Candidate:
+    dp: int
+    pp: int
+    tp: int
+    microbatches: int = 1
+    time_s: Optional[float] = None
+    error: Optional[str] = None
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def generate_candidates(num_devices: int, num_layers: int,
+                        global_batch: int, num_heads: int = 0,
+                        max_mp: Optional[int] = None) -> List[Candidate]:
+    out = []
+    for tp in _divisors(num_devices):
+        if max_mp and tp > max_mp:
+            continue
+        if num_heads and num_heads % tp != 0:
+            continue
+        rest = num_devices // tp
+        for pp in _divisors(rest):
+            dp = rest // pp
+            if num_layers % pp != 0:
+                continue  # prune: uneven stage split
+            if global_batch % dp != 0:
+                continue  # prune: uneven batch shard
+            mbs = [m for m in _divisors(global_batch // dp)
+                   if pp == 1 or m >= pp] or [1]
+            for m in (mbs if pp > 1 else [1]):
+                out.append(Candidate(dp=dp, pp=pp, tp=tp, microbatches=m))
+    return out
+
+
+def prune_by_memory(cands: List[Candidate], param_bytes: int,
+                    hbm_bytes: int, optimizer_mult: float = 4.0
+                    ) -> List[Candidate]:
+    """Drop configs whose per-chip weight+opt state can't fit."""
+    out = []
+    for c in cands:
+        shards = c.tp * c.pp
+        per_chip = param_bytes * optimizer_mult / shards
+        if per_chip <= hbm_bytes * 0.9:
+            out.append(c)
+    return out
+
+
+def tune(run_fn: Callable[[Candidate], float],
+         candidates: List[Candidate], warmup: int = 1, iters: int = 3,
+         verbose: bool = True) -> Candidate:
+    """run_fn(candidate) -> seconds per step (raises on OOM/compile
+    failure). Returns the fastest feasible candidate."""
+    best = None
+    for c in candidates:
+        try:
+            t = run_fn(c)
+            c.time_s = t
+            if verbose:
+                print(f"[auto_tuner] dp={c.dp} pp={c.pp} tp={c.tp} "
+                      f"mb={c.microbatches}: {t * 1e3:.1f} ms/step")
+            if best is None or t < best.time_s:
+                best = c
+        except Exception as e:  # infeasible candidate
+            c.error = f"{type(e).__name__}: {e}"
+            if verbose:
+                print(f"[auto_tuner] dp={c.dp} pp={c.pp} tp={c.tp} "
+                      f"pruned: {c.error[:80]}")
+    if best is None:
+        raise RuntimeError("auto_tuner: no feasible candidate")
+    return best

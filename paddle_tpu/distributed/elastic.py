@@ -1,0 +1,132 @@
+"""Elastic training (reference: ElasticManager,
+fleet/elastic/manager.py:125 — etcd heartbeat membership, scale in/out,
+trainer relaunch; distributed/elastic.py:21).
+
+TPU-native: membership rides the JAX coordination service when available;
+this module provides the heartbeat/membership state machine against a
+pluggable KV store (file-based store for single-host + tests, etcd-style
+interface for clusters) and the relaunch decision logic.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class KVStore:
+    """Pluggable store interface (etcd analog)."""
+
+    def put(self, key: str, value: str, ttl_s: Optional[float] = None):
+        raise NotImplementedError
+
+    def get_prefix(self, prefix: str) -> Dict[str, str]:
+        raise NotImplementedError
+
+    def delete(self, key: str):
+        raise NotImplementedError
+
+
+class FileKVStore(KVStore):
+    """Shared-filesystem store (works across hosts on NFS/GCS-fuse)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key):
+        return os.path.join(self.root, key.replace("/", "__"))
+
+    def put(self, key, value, ttl_s=None):
+        payload = {"value": value,
+                   "expires": time.time() + ttl_s if ttl_s else None}
+        tmp = self._path(key) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, self._path(key))
+
+    def get_prefix(self, prefix):
+        out = {}
+        p = prefix.replace("/", "__")
+        for fn in os.listdir(self.root):
+            if not fn.startswith(p) or fn.endswith(".tmp"):
+                continue
+            try:
+                with open(os.path.join(self.root, fn)) as f:
+                    payload = json.load(f)
+            except (json.JSONDecodeError, OSError):
+                continue
+            if payload.get("expires") and payload["expires"] < time.time():
+                continue
+            out[fn.replace("__", "/")] = payload["value"]
+        return out
+
+    def delete(self, key):
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+
+
+class ElasticManager:
+    """Heartbeat + membership watcher (manager.py:125 semantics):
+    each node heartbeats `{prefix}/nodes/{rank}` with a TTL; the watcher
+    detects join/leave and calls on_change(world) so the trainer can
+    checkpoint + relaunch with new endpoints."""
+
+    def __init__(self, store: KVStore, job_id: str, rank: int,
+                 np_range: Optional[tuple] = None, heartbeat_s: float = 2.0,
+                 ttl_s: float = 6.0,
+                 on_change: Optional[Callable[[List[int]], None]] = None):
+        self.store = store
+        self.prefix = f"elastic/{job_id}"
+        self.rank = rank
+        self.heartbeat_s = heartbeat_s
+        self.ttl_s = ttl_s
+        self.on_change = on_change
+        self.np_min, self.np_max = np_range or (1, 1 << 30)
+        self._stop = threading.Event()
+        self._threads = []
+        self._last_world: List[int] = []
+
+    def world(self) -> List[int]:
+        nodes = self.store.get_prefix(f"{self.prefix}/nodes/")
+        return sorted(int(k.rsplit("/", 1)[-1]) for k in nodes)
+
+    def _heartbeat_loop(self):
+        while not self._stop.is_set():
+            self.store.put(f"{self.prefix}/nodes/{self.rank}",
+                           json.dumps({"ts": time.time()}),
+                           ttl_s=self.ttl_s)
+            self._stop.wait(self.heartbeat_s)
+
+    def _watch_loop(self):
+        while not self._stop.wait(self.heartbeat_s):
+            w = self.world()
+            if w != self._last_world:
+                prev = self._last_world
+                self._last_world = w
+                if prev and self.on_change is not None:
+                    self.on_change(w)
+
+    def start(self):
+        self.store.put(f"{self.prefix}/nodes/{self.rank}",
+                       json.dumps({"ts": time.time()}), ttl_s=self.ttl_s)
+        self._last_world = self.world()
+        for target in (self._heartbeat_loop, self._watch_loop):
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=1.0)
+        self.store.delete(f"{self.prefix}/nodes/{self.rank}")
+
+    def healthy(self) -> bool:
+        n = len(self.world())
+        return self.np_min <= n <= self.np_max

@@ -1,0 +1,113 @@
+"""Collective watchdog (reference: phi/core/distributed/
+comm_task_manager.cc + nccl_comm_task.cc — records start/end of
+collectives, detects hangs, dumps per-rank state).
+
+TPU-native: XLA collectives can't be individually instrumented from
+Python, so the watchdog monitors *device progress*: a heartbeat thread
+issues a tiny probe computation every interval; if the device fails to
+complete it within FLAGS_collective_timeout_s (a hung ICI collective /
+dead coordinator blocks the stream), the watchdog dumps state and invokes
+the timeout callback.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Callable, Optional
+
+import jax
+
+from paddle_tpu.core.flags import get_flag
+
+
+class CollectiveWatchdog:
+    def __init__(self, timeout_s: Optional[float] = None,
+                 interval_s: float = 10.0,
+                 on_timeout: Optional[Callable] = None):
+        self.timeout_s = timeout_s if timeout_s is not None else \
+            get_flag("FLAGS_collective_timeout_s")
+        self.interval_s = interval_s
+        self.on_timeout = on_timeout
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.last_ok = time.monotonic()
+        self.tripped = False
+
+    def _probe_once(self) -> bool:
+        done = threading.Event()
+
+        def work():
+            try:
+                import jax.numpy as jnp
+                (jnp.zeros(()) + 1).block_until_ready()
+                done.set()
+            except Exception:
+                pass
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        return done.wait(self.timeout_s)
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            if self._probe_once():
+                self.last_ok = time.monotonic()
+            else:
+                self.tripped = True
+                self._dump()
+                if self.on_timeout is not None:
+                    self.on_timeout(self)
+                return
+
+    def _dump(self):
+        print("=" * 60)
+        print("[collective watchdog] device probe timed out after "
+              f"{self.timeout_s}s — possible hung collective / dead "
+              "coordination service")
+        try:
+            print("process_index:", jax.process_index(),
+                  "device_count:", len(jax.devices()))
+        except Exception:
+            pass
+        print("live python threads:")
+        for tid, frame in sys_frames():
+            print(f"  thread {tid}:")
+            print("   " + "   ".join(traceback.format_stack(frame)[-3:]))
+        print("=" * 60)
+
+    def start(self):
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+            self._thread = None
+
+
+def sys_frames():
+    import sys
+    return list(sys._current_frames().items())
+
+
+_GLOBAL: Optional[CollectiveWatchdog] = None
+
+
+def start_watchdog(timeout_s=None, interval_s=10.0, on_timeout=None):
+    global _GLOBAL
+    if _GLOBAL is None:
+        _GLOBAL = CollectiveWatchdog(timeout_s, interval_s, on_timeout)
+        _GLOBAL.start()
+    return _GLOBAL
+
+
+def stop_watchdog():
+    global _GLOBAL
+    if _GLOBAL is not None:
+        _GLOBAL.stop()
+        _GLOBAL = None

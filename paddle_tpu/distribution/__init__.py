@@ -1,0 +1,443 @@
+"""paddle.distribution equivalent (reference: python/paddle/distribution/*).
+
+Distributions wrap jax.scipy stats + jax.random sampling through the
+paddle_tpu Tensor/op layer (rsample is differentiable via the
+reparameterization trick where defined).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core import generator as gen_mod
+from paddle_tpu.core.dispatch import run_op
+from paddle_tpu.core.tensor import Tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else paddle.to_tensor(x)
+
+
+def _shape(sample_shape, base_shape):
+    return tuple(int(s) for s in sample_shape) + tuple(base_shape)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        with paddle.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return paddle.exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(np.broadcast_shapes(
+            tuple(self.loc.shape), tuple(self.scale.shape))))
+
+    def rsample(self, shape=()):
+        key = gen_mod.next_key()
+        shp = _shape(shape, self.batch_shape)
+        def f(loc, scale):
+            z = jax.random.normal(key, shp, loc.dtype)
+            return loc + scale * z
+        return run_op("normal_rsample", f, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def f(v, loc, scale):
+            var = scale * scale
+            return -((v - loc) ** 2) / (2 * var) - jnp.log(scale) \
+                - 0.5 * math.log(2 * math.pi)
+        return run_op("normal_log_prob", f, _t(value), self.loc, self.scale)
+
+    def entropy(self):
+        return run_op("normal_entropy",
+                      lambda s: 0.5 + 0.5 * math.log(2 * math.pi)
+                      + jnp.log(s) + jnp.zeros(self.batch_shape, s.dtype),
+                      self.scale)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self.scale * self.scale
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(tuple(np.broadcast_shapes(
+            tuple(self.low.shape), tuple(self.high.shape))))
+
+    def rsample(self, shape=()):
+        key = gen_mod.next_key()
+        shp = _shape(shape, self.batch_shape)
+        def f(lo, hi):
+            u = jax.random.uniform(key, shp, lo.dtype)
+            return lo + (hi - lo) * u
+        return run_op("uniform_rsample", f, self.low, self.high)
+
+    sample = Distribution.sample
+
+    def log_prob(self, value):
+        def f(v, lo, hi):
+            inside = (v >= lo) & (v < hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+        return run_op("uniform_log_prob", f, _t(value), self.low, self.high)
+
+    def entropy(self):
+        return paddle.log(self.high - self.low)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if probs is None:
+            self.logits = _t(logits)
+            self.probs = paddle.sigmoid(self.logits)
+        else:
+            self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    def sample(self, shape=()):
+        key = gen_mod.next_key()
+        shp = _shape(shape, self.batch_shape)
+        def f(p):
+            return jax.random.bernoulli(key, p, shp).astype(p.dtype)
+        return run_op("bernoulli_sample", f, self.probs,
+                      differentiable=False)
+
+    def log_prob(self, value):
+        def f(v, p):
+            eps = 1e-7
+            p = jnp.clip(p, eps, 1 - eps)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+        return run_op("bernoulli_log_prob", f, _t(value), self.probs)
+
+    def entropy(self):
+        def f(p):
+            eps = 1e-7
+            p = jnp.clip(p, eps, 1 - eps)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+        return run_op("bernoulli_entropy", f, self.probs)
+
+    @property
+    def mean(self):
+        return self.probs
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None:
+            self.logits = _t(logits)
+        else:
+            self.logits = paddle.log(_t(probs))
+        super().__init__(tuple(self.logits.shape[:-1]))
+
+    @property
+    def probs(self):
+        from paddle_tpu.nn.functional import softmax
+        return softmax(self.logits, axis=-1)
+
+    def sample(self, shape=()):
+        key = gen_mod.next_key()
+        shp = _shape(shape, self.batch_shape)
+        def f(lg):
+            return jax.random.categorical(key, lg, shape=shp)
+        return run_op("categorical_sample", f, self.logits,
+                      differentiable=False)
+
+    def log_prob(self, value):
+        def f(v, lg):
+            logp = jax.nn.log_softmax(lg, -1)
+            return jnp.take_along_axis(
+                logp, v.astype(jnp.int32)[..., None], -1)[..., 0]
+        return run_op("categorical_log_prob", f, _t(value), self.logits)
+
+    def entropy(self):
+        def f(lg):
+            logp = jax.nn.log_softmax(lg, -1)
+            return -jnp.sum(jnp.exp(logp) * logp, -1)
+        return run_op("categorical_entropy", f, self.logits)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    def rsample(self, shape=()):
+        key = gen_mod.next_key()
+        shp = _shape(shape, self.batch_shape)
+        def f(r):
+            return jax.random.exponential(key, shp, r.dtype) / r
+        return run_op("exponential_rsample", f, self.rate)
+
+    def log_prob(self, value):
+        return run_op("exponential_log_prob",
+                      lambda v, r: jnp.log(r) - r * v, _t(value), self.rate)
+
+    def entropy(self):
+        return 1.0 - paddle.log(self.rate)
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
+        super().__init__(tuple(np.broadcast_shapes(
+            tuple(self.concentration.shape), tuple(self.rate.shape))))
+
+    def rsample(self, shape=()):
+        key = gen_mod.next_key()
+        shp = _shape(shape, self.batch_shape)
+        def f(a, r):
+            return jax.random.gamma(key, jnp.broadcast_to(a, shp)) / r
+        return run_op("gamma_rsample", f, self.concentration, self.rate)
+
+    def log_prob(self, value):
+        def f(v, a, r):
+            return a * jnp.log(r) + (a - 1) * jnp.log(v) - r * v \
+                - jax.lax.lgamma(a)
+        return run_op("gamma_log_prob", f, _t(value), self.concentration,
+                      self.rate)
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(tuple(np.broadcast_shapes(
+            tuple(self.alpha.shape), tuple(self.beta.shape))))
+
+    def rsample(self, shape=()):
+        key = gen_mod.next_key()
+        shp = _shape(shape, self.batch_shape)
+        def f(a, b):
+            return jax.random.beta(key, jnp.broadcast_to(a, shp),
+                                   jnp.broadcast_to(b, shp))
+        return run_op("beta_rsample", f, self.alpha, self.beta)
+
+    def log_prob(self, value):
+        def f(v, a, b):
+            betaln = jax.lax.lgamma(a) + jax.lax.lgamma(b) \
+                - jax.lax.lgamma(a + b)
+            return (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - betaln
+        return run_op("beta_log_prob", f, _t(value), self.alpha, self.beta)
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _t(concentration)
+        super().__init__(tuple(self.concentration.shape[:-1]),
+                         tuple(self.concentration.shape[-1:]))
+
+    def rsample(self, shape=()):
+        key = gen_mod.next_key()
+        shp = _shape(shape, self.concentration.shape)
+        def f(a):
+            g = jax.random.gamma(key, jnp.broadcast_to(a, shp))
+            return g / jnp.sum(g, -1, keepdims=True)
+        return run_op("dirichlet_rsample", f, self.concentration)
+
+    def log_prob(self, value):
+        def f(v, a):
+            lnB = jnp.sum(jax.lax.lgamma(a), -1) \
+                - jax.lax.lgamma(jnp.sum(a, -1))
+            return jnp.sum((a - 1) * jnp.log(v), -1) - lnB
+        return run_op("dirichlet_log_prob", f, _t(value),
+                      self.concentration)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(np.broadcast_shapes(
+            tuple(self.loc.shape), tuple(self.scale.shape))))
+
+    def rsample(self, shape=()):
+        key = gen_mod.next_key()
+        shp = _shape(shape, self.batch_shape)
+        def f(loc, s):
+            return loc + s * jax.random.laplace(key, shp, loc.dtype)
+        return run_op("laplace_rsample", f, self.loc, self.scale)
+
+    def log_prob(self, value):
+        return run_op("laplace_log_prob",
+                      lambda v, loc, s: -jnp.abs(v - loc) / s
+                      - jnp.log(2 * s), _t(value), self.loc, self.scale)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(np.broadcast_shapes(
+            tuple(self.loc.shape), tuple(self.scale.shape))))
+
+    def rsample(self, shape=()):
+        key = gen_mod.next_key()
+        shp = _shape(shape, self.batch_shape)
+        def f(loc, s):
+            return loc + s * jax.random.gumbel(key, shp, loc.dtype)
+        return run_op("gumbel_rsample", f, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def f(v, loc, s):
+            z = (v - loc) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+        return run_op("gumbel_log_prob", f, _t(value), self.loc, self.scale)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self._normal = Normal(loc, scale)
+        self.loc = self._normal.loc
+        self.scale = self._normal.scale
+        super().__init__(self._normal.batch_shape)
+
+    def rsample(self, shape=()):
+        return paddle.exp(self._normal.rsample(shape))
+
+    def log_prob(self, value):
+        v = _t(value)
+        return self._normal.log_prob(paddle.log(v)) - paddle.log(v)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape[:-1]),
+                         tuple(self.probs.shape[-1:]))
+
+    def sample(self, shape=()):
+        key = gen_mod.next_key()
+        def f(p):
+            logits = jnp.log(jnp.maximum(p, 1e-30))
+            draws = jax.random.categorical(
+                key, logits, shape=tuple(shape) + (self.total_count,)
+                + tuple(self.probs.shape[:-1]))
+            k = p.shape[-1]
+            oh = jax.nn.one_hot(draws, k, dtype=p.dtype)
+            axis = len(tuple(shape))
+            return jnp.sum(oh, axis=axis)
+        return run_op("multinomial_sample", f, self.probs,
+                      differentiable=False)
+
+    def log_prob(self, value):
+        def f(v, p):
+            logp = jnp.log(jnp.maximum(p, 1e-30))
+            return jax.lax.lgamma(jnp.asarray(self.total_count + 1.0)) \
+                - jnp.sum(jax.lax.lgamma(v + 1.0), -1) \
+                + jnp.sum(v * logp, -1)
+        return run_op("multinomial_log_prob", f, _t(value), self.probs)
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    def sample(self, shape=()):
+        key = gen_mod.next_key()
+        shp = _shape(shape, self.batch_shape)
+        def f(p):
+            return jax.random.geometric(key, p, shp).astype(p.dtype)
+        return run_op("geometric_sample", f, self.probs,
+                      differentiable=False)
+
+    def log_prob(self, value):
+        return run_op("geometric_log_prob",
+                      lambda v, p: (v - 1) * jnp.log1p(-p) + jnp.log(p),
+                      _t(value), self.probs)
+
+
+# ---------------------------- KL registry ----------------------------------
+_KL = {}
+
+
+def register_kl(cls_p, cls_q):
+    def deco(fn):
+        _KL[(cls_p, cls_q)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p, q):
+    fn = _KL.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(
+            f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    def f(lp, sp, lq, sq):
+        var_ratio = (sp / sq) ** 2
+        t1 = ((lp - lq) / sq) ** 2
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+    return run_op("kl_normal", f, p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat(p, q):
+    def f(lp, lq):
+        a = jax.nn.log_softmax(lp, -1)
+        b = jax.nn.log_softmax(lq, -1)
+        return jnp.sum(jnp.exp(a) * (a - b), -1)
+    return run_op("kl_categorical", f, p.logits, q.logits)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bern(p, q):
+    def f(pp, pq):
+        eps = 1e-7
+        pp = jnp.clip(pp, eps, 1 - eps)
+        pq = jnp.clip(pq, eps, 1 - eps)
+        return pp * (jnp.log(pp) - jnp.log(pq)) + \
+            (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-pq))
+    return run_op("kl_bernoulli", f, p.probs, q.probs)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    def f(pl, ph, ql, qh):
+        res = jnp.log((qh - ql) / (ph - pl))
+        return jnp.where((ql <= pl) & (ph <= qh), res, jnp.inf)
+    return run_op("kl_uniform", f, p.low, p.high, q.low, q.high)

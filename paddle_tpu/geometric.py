@@ -1,0 +1,83 @@
+"""paddle.geometric equivalent: segment + message-passing ops
+(reference: python/paddle/geometric over phi segment kernels).
+TPU-native: jax.ops.segment_* (sorted-scatter XLA lowering)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import run_op
+from paddle_tpu.core.tensor import Tensor
+
+
+def _nseg(ids):
+    import numpy as np
+    return int(np.asarray(ids._data).max()) + 1 if ids.size else 0
+
+
+def segment_sum(data, segment_ids, name=None):
+    n = _nseg(segment_ids)
+    return run_op("segment_sum",
+                  lambda d, i: jax.ops.segment_sum(
+                      d, i.astype(jnp.int32), num_segments=n),
+                  data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    n = _nseg(segment_ids)
+    def f(d, i):
+        i = i.astype(jnp.int32)
+        s = jax.ops.segment_sum(d, i, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones_like(d[..., :1]), i,
+                                  num_segments=n)
+        return s / jnp.maximum(cnt, 1)
+    return run_op("segment_mean", f, data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    n = _nseg(segment_ids)
+    return run_op("segment_max",
+                  lambda d, i: jax.ops.segment_max(
+                      d, i.astype(jnp.int32), num_segments=n),
+                  data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    n = _nseg(segment_ids)
+    return run_op("segment_min",
+                  lambda d, i: jax.ops.segment_min(
+                      d, i.astype(jnp.int32), num_segments=n),
+                  data, segment_ids)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x at src, scatter-reduce at dst (graph message passing)."""
+    import numpy as np
+    n = out_size or (int(np.asarray(dst_index._data).max()) + 1)
+    def f(a, src, dst):
+        msgs = jnp.take(a, src.astype(jnp.int32), axis=0)
+        red = {"sum": jax.ops.segment_sum, "mean": None,
+               "max": jax.ops.segment_max,
+               "min": jax.ops.segment_min}[reduce_op]
+        if reduce_op == "mean":
+            s = jax.ops.segment_sum(msgs, dst.astype(jnp.int32),
+                                    num_segments=n)
+            cnt = jax.ops.segment_sum(
+                jnp.ones((msgs.shape[0], 1), msgs.dtype),
+                dst.astype(jnp.int32), num_segments=n)
+            return s / jnp.maximum(cnt, 1)
+        return red(msgs, dst.astype(jnp.int32), num_segments=n)
+    return run_op("send_u_recv", f, x, src_index, dst_index)
+
+
+def send_ue_recv(x, e, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    import numpy as np
+    n = out_size or (int(np.asarray(dst_index._data).max()) + 1)
+    def f(a, ew, src, dst):
+        msgs = jnp.take(a, src.astype(jnp.int32), axis=0)
+        msgs = msgs + ew if message_op == "add" else msgs * ew
+        return jax.ops.segment_sum(msgs, dst.astype(jnp.int32),
+                                   num_segments=n)
+    return run_op("send_ue_recv", f, x, e, src_index, dst_index)

@@ -1,0 +1,9 @@
+"""paddle.incubate equivalent — the fused-op functional surface
+(reference: python/paddle/incubate/nn/functional/*: fused_rms_norm,
+fused_rotary_position_embedding, swiglu, fused_moe, fused_linear,
+masked_multihead_attention, variable_length_memory_efficient_attention).
+
+On TPU these are XLA-fused jnp graphs or Pallas kernels; keeping the
+incubate names gives drop-in parity for reference model code.
+"""
+from . import nn  # noqa: F401

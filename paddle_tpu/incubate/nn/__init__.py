@@ -1,0 +1,7 @@
+from . import functional  # noqa: F401
+
+
+class FusedLinear:
+    def __new__(cls, in_features, out_features, bias_attr=None, **kw):
+        from paddle_tpu.nn import Linear
+        return Linear(in_features, out_features, bias_attr=bias_attr)

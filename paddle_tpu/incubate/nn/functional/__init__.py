@@ -1,0 +1,137 @@
+"""Fused functional ops (reference: paddle/incubate/nn/functional)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import run_op
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn import functional as F
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, **kw):
+    """reference fused_rms_norm.py — returns (out, invvar) pair shape."""
+    out = F.rms_norm(x, norm_weight, norm_bias, epsilon, begin_norm_axis)
+    return out, None
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1, **kw):
+    shape = x.shape[begin_norm_axis:] if begin_norm_axis >= 0 else \
+        x.shape[begin_norm_axis:]
+    return F.layer_norm(x, shape, norm_weight, norm_bias, epsilon), None
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True):
+    """reference fused_rotary_position_embedding: applies RoPE to q/k
+    ([B, S, H, D] layout)."""
+    from paddle_tpu.models.llama import apply_rotary_pos_emb
+    outs = [apply_rotary_pos_emb(q)]
+    outs.append(apply_rotary_pos_emb(k) if k is not None else None)
+    outs.append(v)
+    return tuple(outs)
+
+
+def swiglu(x, y=None, name=None):
+    """reference swiglu fused op: silu(x) * y (or split x in half)."""
+    if y is not None:
+        return run_op("swiglu", lambda a, b: jax.nn.silu(a) * b, x, y)
+    def f(a):
+        a1, a2 = jnp.split(a, 2, axis=-1)
+        return jax.nn.silu(a1) * a2
+    return run_op("swiglu", f, x)
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", **kw):
+    def f(a, *b):
+        if b:
+            a = a + b[0]
+        if act_method == "gelu":
+            return jax.nn.gelu(a)
+        if act_method in ("silu", "swish"):
+            return jax.nn.silu(a)
+        return jax.nn.relu(a)
+    if bias is not None:
+        return run_op("fused_bias_act", f, x, bias)
+    return run_op("fused_bias_act", f, x)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    def f(a, w, *b):
+        wt = w.T if transpose_weight else w
+        out = a @ wt
+        if b:
+            out = out + b[0]
+        return out
+    if bias is not None:
+        return run_op("fused_linear", f, x, weight, bias)
+    return run_op("fused_linear", f, x, weight)
+
+
+def fused_linear_activation(x, weight, bias=None, activation="gelu",
+                            **kw):
+    out = fused_linear(x, weight, bias)
+    return getattr(F, activation)(out)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    return F.dropout(x, p, training=training, mode=mode) + y
+
+
+def fused_moe(x, gate_weight, ffn1_weight, ffn1_bias, ffn2_weight,
+              ffn2_bias, top_k=2, norm_topk_prob=True, **kw):
+    """reference fused_moe.py — dense-dispatch GShard MoE."""
+    def f(a, gw, w1, b1, w2, b2):
+        b, s, h = a.shape
+        tokens = a.reshape(b * s, h)
+        e = gw.shape[-1]
+        probs = jax.nn.softmax(
+            tokens.astype(jnp.float32) @ gw.astype(jnp.float32), -1)
+        topv, topi = jax.lax.top_k(probs, top_k)
+        if norm_topk_prob:
+            topv = topv / jnp.sum(topv, -1, keepdims=True)
+        disp = jnp.zeros_like(probs)
+        comb = jnp.zeros_like(probs)
+        for j in range(top_k):
+            oh = jax.nn.one_hot(topi[:, j], e, dtype=probs.dtype)
+            disp = disp + oh
+            comb = comb + oh * topv[:, j:j + 1]
+        xin = jnp.einsum("te,th->eth", disp.astype(a.dtype), tokens)
+        hmid = jax.nn.gelu(jnp.einsum("eth,ehm->etm", xin, w1)
+                           + b1[:, None])
+        hout = jnp.einsum("etm,emh->eth", hmid, w2) + b2[:, None]
+        out = jnp.einsum("te,eth->th", comb.astype(a.dtype), hout)
+        return out.reshape(b, s, h)
+    return run_op("fused_moe", f, x, gate_weight, ffn1_weight, ffn1_bias,
+                  ffn2_weight, ffn2_bias)
+
+
+def masked_multihead_attention(x, cache_kv=None, **kw):
+    raise NotImplementedError(
+        "decode-path masked_multihead_attention: use the KV-cache path in "
+        "paddle_tpu.models.llama (LlamaModel with caches)")
+
+
+def variable_length_memory_efficient_attention(query, key, value,
+                                               seq_lens=None,
+                                               kv_seq_lens=None,
+                                               mask=None, scale=None,
+                                               causal=False):
+    out, _ = F.flash_attn_unpadded(query, key, value, seq_lens,
+                                   kv_seq_lens, None, None, scale=scale,
+                                   causal=causal) \
+        if seq_lens is not None else (None, None)
+    if out is None:
+        return F.scaled_dot_product_attention(query, key, value, mask,
+                                              is_causal=causal)
+    return out
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, **kw):
+    raise NotImplementedError(
+        "use paddle_tpu.nn.MultiHeadAttention (XLA fuses the projections)")

@@ -1,0 +1,122 @@
+"""paddle.inference equivalent (reference: AnalysisPredictor,
+fluid/inference/api/analysis_predictor.h:105 — config + predictor with
+zero-copy tensors, pass pipelines, TensorRT bridges).
+
+TPU-native: the "analysis + optimization passes + engine" stack IS XLA;
+Predictor wraps a jit-compiled forward with an executable cache. Model
+artifacts are paddle_tpu.jit.save outputs (state dict + StableHLO text).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+class Config:
+    def __init__(self, model_path: Optional[str] = None,
+                 params_path: Optional[str] = None):
+        self.model_path = model_path
+        self.params_path = params_path
+        self._layer = None
+        self._donate = True
+
+    # reference-config surface (most knobs are XLA-internal now)
+    def enable_use_gpu(self, *a, **k):
+        pass
+
+    def enable_tpu(self, *a, **k):
+        pass
+
+    def disable_gpu(self):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        pass
+
+    def enable_memory_optim(self, flag=True):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def enable_tensorrt_engine(self, *a, **k):
+        raise NotImplementedError("TensorRT has no TPU analog; XLA "
+                                  "compiles the graph directly")
+
+    def set_model(self, model_path, params_path=None):
+        self.model_path = model_path
+        self.params_path = params_path
+
+    def set_layer(self, layer):
+        """Directly serve an in-memory Layer (fast path)."""
+        self._layer = layer
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        self._config = config
+        self._layer = config._layer
+        if self._layer is None and config.model_path:
+            raise NotImplementedError(
+                "file-based predictor loading requires the Layer class; "
+                "use Config.set_layer(layer) + layer.set_state_dict("
+                "paddle.load(...)) or paddle_tpu.jit.load")
+        self._inputs: Dict[str, Tensor] = {}
+        self._compiled = None
+
+    def get_input_names(self):
+        return list(self._inputs) or ["x"]
+
+    def get_input_handle(self, name):
+        t = self._inputs.setdefault(name, paddle.zeros([1]))
+        return _Handle(t)
+
+    def get_output_names(self):
+        return ["out"]
+
+    def get_output_handle(self, name):
+        return _Handle(self._last_out)
+
+    def run(self, inputs: Optional[List[Tensor]] = None):
+        args = inputs if inputs is not None else list(self._inputs.values())
+        args = [a if isinstance(a, Tensor) else paddle.to_tensor(a)
+                for a in args]
+        if self._compiled is None:
+            self._layer.eval()
+            self._compiled = paddle.jit.to_static(
+                lambda *xs: self._layer(*xs), objs=[self._layer],
+                donate=False)
+        with paddle.no_grad():
+            out = self._compiled(*args)
+        self._last_out = out if isinstance(out, Tensor) else out[0]
+        return [self._last_out] if isinstance(out, Tensor) else list(out)
+
+
+class _Handle:
+    """Zero-copy tensor handle parity."""
+
+    def __init__(self, t: Tensor):
+        self._t = t
+
+    def reshape(self, shape):
+        import jax.numpy as jnp
+        self._t._assign_array(jnp.zeros(shape, self._t._data.dtype))
+
+    def copy_from_cpu(self, arr):
+        import jax.numpy as jnp
+        self._t._assign_array(jnp.asarray(np.asarray(arr)))
+
+    def copy_to_cpu(self):
+        return self._t.numpy()
+
+    def shape(self):
+        return self._t.shape
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -1,0 +1,151 @@
+"""paddle.quantization equivalent (reference: python/paddle/quantization —
+QAT/PTQ framework with QuantConfig, quanters, observers).
+
+TPU-native: fake-quant (quantize-dequantize) runs as XLA elementwise
+graphs with straight-through-estimator gradients; int8 inference maps to
+XLA int8 dots on supporting hardware.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core.dispatch import run_op
+from paddle_tpu.core.tensor import Tensor
+
+
+def quantize_dequantize(x, scale, zero_point=0.0, bit_length=8):
+    """Fake-quant with STE gradient."""
+    qmin, qmax = -(2 ** (bit_length - 1)), 2 ** (bit_length - 1) - 1
+    def f(a, s):
+        s = jnp.maximum(s, 1e-8)
+        q = jnp.clip(jnp.round(a / s), qmin, qmax)
+        deq = q * s
+        # straight-through: gradient flows as identity within range
+        return a + jax.lax.stop_gradient(deq - a)
+    return run_op("fake_quant", f, x, scale)
+
+
+class AbsmaxObserver:
+    """PTQ observer collecting abs-max scale."""
+
+    def __init__(self, bit_length=8):
+        self.bit_length = bit_length
+        self._absmax = 0.0
+
+    def observe(self, x: Tensor):
+        self._absmax = max(self._absmax,
+                           float(np.abs(np.asarray(x._data)).max()))
+
+    def scale(self):
+        qmax = 2 ** (self.bit_length - 1) - 1
+        return self._absmax / qmax if self._absmax else 1.0
+
+
+class FakeQuanterWithAbsMax(nn.Layer):
+    """QAT quanter: learns running abs-max scale."""
+
+    def __init__(self, bit_length=8, moving_rate=0.9):
+        super().__init__()
+        self.bit_length = bit_length
+        self.moving_rate = moving_rate
+        self.register_buffer("_scale", paddle.ones([1]))
+        self._seen = False
+
+    def forward(self, x):
+        if self.training:
+            cur = paddle.max(paddle.abs(x)).detach()
+            qmax = 2 ** (self.bit_length - 1) - 1
+            if not self._seen:
+                new_scale = cur / qmax  # direct init on first batch
+                self._seen = True
+            else:
+                new_scale = self.moving_rate * self._scale \
+                    + (1 - self.moving_rate) * (cur / qmax)
+            self._scale._assign_array(
+                jnp.reshape(new_scale._data, (1,)))
+        return quantize_dequantize(x, self._scale, 0.0, self.bit_length)
+
+
+class QuantedLinear(nn.Layer):
+    def __init__(self, linear: nn.Linear, bit_length=8):
+        super().__init__()
+        self.inner = linear
+        self.act_quanter = FakeQuanterWithAbsMax(bit_length)
+        self.weight_quanter = FakeQuanterWithAbsMax(bit_length)
+
+    def forward(self, x):
+        from paddle_tpu.nn import functional as F
+        xq = self.act_quanter(x)
+        wq = self.weight_quanter(self.inner.weight)
+        return F.linear(xq, wq, self.inner.bias)
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._types = (nn.Linear,)
+
+    def add_type_config(self, layer_types, activation=None, weight=None):
+        self._types = tuple(layer_types) if isinstance(
+            layer_types, (list, tuple)) else (layer_types,)
+
+
+class QAT:
+    """Quantization-aware training: swap Linear -> QuantedLinear."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: nn.Layer, inplace=False):
+        for name, layer in list(model.named_sublayers(include_self=True)):
+            for cname, child in list(layer._sub_layers.items()):
+                if isinstance(child, self.config._types) and \
+                        not isinstance(child, QuantedLinear):
+                    layer.add_sublayer(cname, QuantedLinear(child))
+        return model
+
+
+class PTQ:
+    """Post-training quantization: observe activations, then freeze."""
+
+    def __init__(self, config: QuantConfig = None):
+        self.config = config or QuantConfig()
+        self._observers = {}
+
+    def quantize(self, model: nn.Layer, inplace=False):
+        self._hooks = []
+        for name, layer in model.named_sublayers(include_self=True):
+            if isinstance(layer, self.config._types):
+                obs = AbsmaxObserver()
+                self._observers[id(layer)] = obs
+
+                def hook(l, inputs, _obs=obs):
+                    _obs.observe(inputs[0])
+                self._hooks.append(
+                    layer.register_forward_pre_hook(hook))
+        return model
+
+    def convert(self, model: nn.Layer, inplace=False):
+        for h in getattr(self, "_hooks", []):
+            h.remove()
+        for name, layer in list(model.named_sublayers(include_self=True)):
+            for cname, child in list(layer._sub_layers.items()):
+                obs = self._observers.get(id(child))
+                if obs is not None:
+                    scale = obs.scale()
+                    q = QuantedLinear(child)
+                    q.act_quanter._scale._assign_array(
+                        jnp.asarray([scale], jnp.float32))
+                    q.act_quanter.eval()
+                    q.weight_quanter.eval()
+                    wmax = float(np.abs(np.asarray(
+                        child.weight._data)).max())
+                    q.weight_quanter._scale._assign_array(
+                        jnp.asarray([wmax / 127.0], jnp.float32))
+                    layer.add_sublayer(cname, q)
+        return model

@@ -1,0 +1,101 @@
+"""paddle.sparse equivalent over jax.experimental.sparse BCOO
+(reference: phi sparse_coo/csr tensors + paddle.sparse API)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+class SparseCooTensor(Tensor):
+    """Tensor whose storage is a BCOO sparse array."""
+
+    @classmethod
+    def _wrap_bcoo(cls, bcoo, stop_gradient=True):
+        t = cls.__new__(cls)
+        t._init_from_array(bcoo.todense(), stop_gradient)
+        t._bcoo = bcoo
+        return t
+
+    def indices(self):
+        return Tensor._wrap(self._bcoo.indices.T)
+
+    def values(self):
+        return Tensor._wrap(self._bcoo.data)
+
+    def to_dense(self):
+        return Tensor._wrap(self._bcoo.todense(), self.stop_gradient)
+
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    @property
+    def is_sparse_coo(self):
+        return True
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    idx = indices._data if isinstance(indices, Tensor) else \
+        jnp.asarray(np.asarray(indices))
+    val = values._data if isinstance(values, Tensor) else \
+        jnp.asarray(np.asarray(values))
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in np.asarray(idx).max(axis=1))
+    bcoo = jsparse.BCOO((val, idx.T.astype(jnp.int32)),
+                        shape=tuple(int(s) for s in shape))
+    return SparseCooTensor._wrap_bcoo(bcoo, stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    crows_np = np.asarray(crows.numpy() if isinstance(crows, Tensor)
+                          else crows)
+    cols_np = np.asarray(cols.numpy() if isinstance(cols, Tensor)
+                         else cols)
+    rows = np.repeat(np.arange(len(crows_np) - 1),
+                     np.diff(crows_np))
+    idx = np.stack([rows, cols_np])
+    return sparse_coo_tensor(idx, values, shape, dtype, place,
+                             stop_gradient)
+
+
+def to_sparse_coo(x: Tensor, sparse_dim=None):
+    bcoo = jsparse.BCOO.fromdense(x._data)
+    return SparseCooTensor._wrap_bcoo(bcoo, x.stop_gradient)
+
+
+def to_dense(x):
+    if isinstance(x, SparseCooTensor):
+        return x.to_dense()
+    return x
+
+
+def matmul(x, y, name=None):
+    if isinstance(x, SparseCooTensor):
+        out = jsparse.bcoo_dot_general(
+            x._bcoo, y._data if isinstance(y, Tensor) else jnp.asarray(y),
+            dimension_numbers=(((x._bcoo.ndim - 1,), (0,)), ((), ())))
+        return Tensor._wrap(out)
+    return paddle.matmul(x, y)
+
+
+def add(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        return SparseCooTensor._wrap_bcoo(
+            jsparse.bcoo_add(x._bcoo, y._bcoo)
+            if hasattr(jsparse, "bcoo_add")
+            else jsparse.BCOO.fromdense(x._bcoo.todense()
+                                        + y._bcoo.todense()))
+    return paddle.add(to_dense(x), to_dense(y))
+
+
+def mask_as(x: Tensor, mask: SparseCooTensor):
+    idx = mask._bcoo.indices
+    vals = x._data[tuple(idx.T)]
+    return SparseCooTensor._wrap_bcoo(
+        jsparse.BCOO((vals, idx), shape=x._data.shape))

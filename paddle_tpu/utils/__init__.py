@@ -1,0 +1,52 @@
+"""paddle.utils equivalent."""
+from __future__ import annotations
+
+import functools
+import warnings
+
+from . import unique_name  # noqa: F401
+from . import cpp_extension  # noqa: F401
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            warnings.warn(
+                f"{fn.__name__} is deprecated since {since}: {reason} "
+                f"(use {update_to})", DeprecationWarning, stacklevel=2)
+            return fn(*a, **k)
+        return wrapper
+    return deco
+
+
+def run_check():
+    """paddle.utils.run_check: verify install + device access."""
+    import jax
+    import paddle_tpu as paddle
+    x = paddle.randn([4, 4])
+    y = (x @ x).sum()
+    y.backward() if not x.stop_gradient else None
+    n = len(jax.devices())
+    print(f"paddle_tpu is installed successfully! "
+          f"backend={jax.default_backend()}, devices={n}")
+    return True
+
+
+def try_import(name):
+    import importlib
+    try:
+        return importlib.import_module(name)
+    except ImportError:
+        return None
+
+
+def flatten(nest):
+    import jax
+    return jax.tree_util.tree_leaves(nest)
+
+
+def pack_sequence_as(structure, flat):
+    import jax
+    treedef = jax.tree_util.tree_structure(structure)
+    return jax.tree_util.tree_unflatten(treedef, flat)

@@ -1,0 +1,124 @@
+"""Custom C++ op extension (reference: paddle.utils.cpp_extension —
+JIT-builds user C++ ops declared with PD_BUILD_OP, op_meta_info.h:1140,
+registered into eager+static).
+
+TPU-native split: device compute for custom ops should be a Pallas/jax
+function (register_op below); HOST-side native code (pre/post-processing,
+IO) is compiled here with g++ and bound via ctypes — pybind11-free.
+A custom op registered with both a python/jax `forward` and optional
+`backward` participates in autograd like any built-in op.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+from typing import Callable, Dict, List, Optional
+
+_REGISTRY: Dict[str, "CustomOp"] = {}
+
+
+class CustomOp:
+    def __init__(self, name, forward, backward=None, infer_shape=None,
+                 infer_dtype=None):
+        self.name = name
+        self.forward = forward
+        self.backward = backward
+        self.infer_shape = infer_shape
+        self.infer_dtype = infer_dtype
+
+    def __call__(self, *tensors, **attrs):
+        from paddle_tpu.core.dispatch import run_op
+        from paddle_tpu.autograd import PyLayer
+
+        if self.backward is None:
+            def f(*arrays):
+                return self.forward(*arrays, **attrs)
+            return run_op(self.name, f, *tensors)
+
+        fwd, bwd = self.forward, self.backward
+
+        class _Op(PyLayer):
+            @staticmethod
+            def forward(ctx, *xs):
+                ctx.save_for_backward(*xs)
+                import jax.numpy as jnp
+                from paddle_tpu.core.tensor import Tensor
+                arrays = [x._data for x in xs]
+                out = fwd(*arrays, **attrs)
+                outs = out if isinstance(out, (tuple, list)) else [out]
+                ts = [Tensor._wrap(o) for o in outs]
+                return ts[0] if len(ts) == 1 else tuple(ts)
+
+            @staticmethod
+            def backward(ctx, *gs):
+                from paddle_tpu.core.tensor import Tensor
+                saved = [t._data for t in ctx.saved_tensor]
+                grads = bwd(*saved, *[g._data for g in gs], **attrs)
+                grads = grads if isinstance(grads, (tuple, list)) \
+                    else [grads]
+                return tuple(Tensor._wrap(g) for g in grads)
+
+        _Op.__name__ = self.name
+        return _Op.apply(*tensors)
+
+
+def register_op(name: str, forward: Callable, backward: Callable = None,
+                infer_shape=None, infer_dtype=None) -> CustomOp:
+    """PD_BUILD_OP analog: register a custom op (jax-traceable forward /
+    backward on raw arrays)."""
+    op = CustomOp(name, forward, backward, infer_shape, infer_dtype)
+    _REGISTRY[name] = op
+    return op
+
+
+def get_op(name: str) -> CustomOp:
+    return _REGISTRY[name]
+
+
+# ---------------------------------------------------------------------------
+# native host-code JIT build (ctypes, no pybind11)
+# ---------------------------------------------------------------------------
+def load(name: str, sources: List[str], extra_cxx_cflags: List[str] = None,
+         extra_ldflags: List[str] = None, build_directory: str = None,
+         verbose: bool = False):
+    """Compile C/C++ sources into a shared library and return the
+    ctypes.CDLL handle (the user declares extern "C" entry points)."""
+    build_dir = build_directory or os.path.join(
+        os.path.expanduser("~/.cache/paddle_tpu_extensions"), name)
+    os.makedirs(build_dir, exist_ok=True)
+    tag = hashlib.md5("".join(
+        open(s).read() for s in sources).encode()).hexdigest()[:12]
+    so = os.path.join(build_dir, f"{name}_{tag}.so")
+    if not os.path.exists(so):
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17"] + \
+            (extra_cxx_cflags or []) + sources + ["-o", so] + \
+            (extra_ldflags or [])
+        if verbose:
+            print("[cpp_extension]", " ".join(cmd))
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+    return ctypes.CDLL(so)
+
+
+class CppExtension:
+    def __init__(self, sources, **kwargs):
+        self.sources = sources
+        self.kwargs = kwargs
+
+
+class CUDAExtension(CppExtension):
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "CUDA extensions have no TPU analog; write device compute as a "
+            "Pallas kernel and register it with register_op()")
+
+
+def setup(name=None, ext_modules=None, **kwargs):
+    """paddle.utils.cpp_extension.setup analog: builds each CppExtension
+    immediately (JIT) rather than via setuptools."""
+    libs = {}
+    for ext in (ext_modules if isinstance(ext_modules, (list, tuple))
+                else [ext_modules]):
+        libs[name] = load(name, ext.sources, **ext.kwargs)
+    return libs

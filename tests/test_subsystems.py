@@ -1,0 +1,222 @@
+"""Tests: distribution, sparse, geometric, device, incubate, quantization,
+inference, custom ops, watchdog, elastic, auto_tuner."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_distribution_normal():
+    from paddle_tpu.distribution import Normal, kl_divergence
+    d = Normal(0.0, 1.0)
+    s = d.sample([1000])
+    assert abs(float(s.mean())) < 0.15
+    lp = d.log_prob(paddle.to_tensor(0.0))
+    np.testing.assert_allclose(float(lp), -0.9189385, rtol=1e-5)
+    q = Normal(1.0, 2.0)
+    kl = kl_divergence(d, q)
+    # closed form: log(2) + (1+1)/8 - 1/2
+    np.testing.assert_allclose(float(kl), np.log(2) + 2 / 8 - 0.5,
+                               rtol=1e-5)
+
+
+def test_distribution_rsample_grad():
+    from paddle_tpu.distribution import Normal
+    loc = paddle.to_tensor(0.5, stop_gradient=False)
+    scale = paddle.to_tensor(1.5, stop_gradient=False)
+    d = Normal(loc, scale)
+    s = d.rsample([64])
+    s.mean().backward()
+    assert loc.grad is not None and abs(float(loc.grad) - 1.0) < 1e-5
+
+
+def test_distribution_categorical_bernoulli():
+    from paddle_tpu.distribution import Bernoulli, Categorical
+    c = Categorical(logits=paddle.to_tensor([0.0, 0.0, 10.0]))
+    s = c.sample([100])
+    assert float((s == 2).astype("float32").mean()) > 0.95
+    ent = c.entropy()
+    assert float(ent) < 0.1
+    b = Bernoulli(probs=paddle.to_tensor(0.8))
+    np.testing.assert_allclose(float(b.log_prob(paddle.to_tensor(1.0))),
+                               np.log(0.8), rtol=1e-5)
+
+
+def test_sparse_coo():
+    import paddle_tpu.sparse as sparse
+    idx = [[0, 1, 2], [1, 2, 0]]
+    vals = [1.0, 2.0, 3.0]
+    s = sparse.sparse_coo_tensor(idx, vals, shape=[3, 3])
+    dense = s.to_dense().numpy()
+    assert dense[0, 1] == 1 and dense[1, 2] == 2 and dense[2, 0] == 3
+    assert s.nnz() == 3
+    y = sparse.matmul(s, paddle.eye(3))
+    np.testing.assert_allclose(y.numpy(), dense)
+    s2 = sparse.to_sparse_coo(paddle.to_tensor(dense))
+    np.testing.assert_allclose(s2.to_dense().numpy(), dense)
+
+
+def test_geometric_segment_ops():
+    import paddle_tpu.geometric as G
+    data = paddle.to_tensor([[1.0], [2.0], [3.0], [4.0]])
+    ids = paddle.to_tensor([0, 0, 1, 1])
+    np.testing.assert_allclose(G.segment_sum(data, ids).numpy(),
+                               [[3.0], [7.0]])
+    np.testing.assert_allclose(G.segment_mean(data, ids).numpy(),
+                               [[1.5], [3.5]])
+    np.testing.assert_allclose(G.segment_max(data, ids).numpy(),
+                               [[2.0], [4.0]])
+    x = paddle.to_tensor([[1.0, 0.0], [0.0, 1.0], [2.0, 2.0]])
+    src = paddle.to_tensor([0, 1, 1])
+    dst = paddle.to_tensor([1, 0, 2])
+    out = G.send_u_recv(x, src, dst, "sum")
+    np.testing.assert_allclose(out.numpy(),
+                               [[0, 1], [1, 0], [0, 1]])
+
+
+def test_device_api():
+    import paddle_tpu.device as device
+    assert device.device_count() >= 1
+    assert isinstance(device.get_available_device(), list)
+    device.synchronize()
+    assert device.memory_allocated() >= 0
+
+
+def test_incubate_fused_ops():
+    import paddle_tpu.incubate.nn.functional as IF
+    x = paddle.randn([2, 4, 8], dtype="float32")
+    w = paddle.ones([8])
+    out, _ = IF.fused_rms_norm(x, w)
+    ref = x.numpy() / np.sqrt(
+        (x.numpy() ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+    y = IF.swiglu(paddle.randn([2, 8]))
+    assert y.shape == [2, 4]
+    q = paddle.randn([1, 4, 2, 8])
+    k = paddle.randn([1, 4, 2, 8])
+    q2, k2, _ = IF.fused_rotary_position_embedding(q, k)
+    assert q2.shape == q.shape and k2.shape == k.shape
+
+
+def test_quantization_qat_and_ptq():
+    from paddle_tpu.quantization import PTQ, QAT, QuantConfig
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 4))
+    x = paddle.randn([4, 8])
+    ref = m(x)
+    qat_model = QAT(QuantConfig()).quantize(m)
+    out = qat_model(x)
+    assert out.shape == [4, 4]
+    # quantized forward should be close-ish but not exact
+    assert np.abs(out.numpy() - ref.numpy()).max() < 1.0
+    # QAT still trains
+    loss = out.sum()
+    loss.backward()
+    assert m[0].inner.weight.grad is not None
+
+    m2 = nn.Sequential(nn.Linear(8, 4))
+    ptq = PTQ(QuantConfig())
+    ptq.quantize(m2)
+    for _ in range(3):
+        m2(paddle.randn([4, 8]))
+    ptq.convert(m2)
+    out2 = m2(x)
+    assert out2.shape == [4, 4]
+
+
+def test_inference_predictor():
+    from paddle_tpu.inference import Config, create_predictor
+    m = nn.Linear(4, 2)
+    cfg = Config()
+    cfg.set_layer(m)
+    pred = create_predictor(cfg)
+    x = paddle.randn([3, 4])
+    (out,) = pred.run([x])
+    np.testing.assert_allclose(out.numpy(), m(x).numpy(), rtol=1e-5)
+
+
+def test_custom_op_with_grad():
+    from paddle_tpu.utils.cpp_extension import register_op
+    import jax.numpy as jnp
+    op = register_op(
+        "my_double",
+        forward=lambda x: x * 2.0,
+        backward=lambda x, g: g * 2.0)
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = op(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_cpp_extension_load(tmp_path):
+    from paddle_tpu.utils.cpp_extension import load
+    src = tmp_path / "ext.cc"
+    src.write_text(
+        'extern "C" long long addll(long long a, long long b) '
+        "{ return a + b; }\n")
+    lib = load("testext", [str(src)], build_directory=str(tmp_path))
+    import ctypes
+    lib.addll.restype = ctypes.c_longlong
+    assert lib.addll(ctypes.c_longlong(40), ctypes.c_longlong(2)) == 42
+
+
+def test_watchdog_healthy():
+    from paddle_tpu.distributed.watchdog import CollectiveWatchdog
+    wd = CollectiveWatchdog(timeout_s=30, interval_s=0.05)
+    wd.start()
+    import time
+    time.sleep(0.3)
+    wd.stop()
+    assert not wd.tripped
+
+
+def test_elastic_membership(tmp_path):
+    from paddle_tpu.distributed.elastic import ElasticManager, FileKVStore
+    store = FileKVStore(str(tmp_path))
+    changes = []
+    m0 = ElasticManager(store, "job1", 0, heartbeat_s=0.05, ttl_s=0.5,
+                        on_change=lambda w: changes.append(list(w)))
+    m1 = ElasticManager(store, "job1", 1, heartbeat_s=0.05, ttl_s=0.5)
+    m0.start()
+    m1.start()
+    import time
+    time.sleep(0.3)
+    assert m0.world() == [0, 1]
+    m1.stop()
+    time.sleep(1.0)
+    assert m0.world() == [0]
+    assert changes and changes[-1] == [0]
+    m0.stop()
+
+
+def test_auto_tuner():
+    from paddle_tpu.distributed.auto_tuner import (Candidate,
+                                                   generate_candidates,
+                                                   prune_by_memory, tune)
+    cands = generate_candidates(8, num_layers=4, global_batch=16,
+                                num_heads=8)
+    assert all(c.dp * c.pp * c.tp == 8 for c in cands)
+    assert any(c.pp > 1 for c in cands)
+    pruned = prune_by_memory(cands, param_bytes=10 * 2 ** 30,
+                             hbm_bytes=16 * 2 ** 30, optimizer_mult=4)
+    assert all(c.tp * c.pp >= 4 for c in pruned)
+
+    def fake_run(c):
+        if c.tp == 8:
+            raise RuntimeError("oom")
+        return 1.0 / (c.dp + 0.5 * c.tp)
+
+    best = tune(fake_run, cands, verbose=False)
+    assert best.error is None and best.time_s is not None
+
+
+def test_unique_name_and_run_check():
+    from paddle_tpu.utils import run_check, unique_name
+    a = unique_name.generate("fc")
+    b = unique_name.generate("fc")
+    assert a != b
+    with unique_name.guard():
+        c = unique_name.generate("fc")
+        assert c == "fc_0"
+    assert run_check()
