@@ -40,6 +40,7 @@ from paddle_tpu.ops.random import (  # noqa: F401
 )
 
 # ---- autograd -------------------------------------------------------------
+from paddle_tpu import _C_ops  # noqa: F401  (generated dispatch surface)
 from paddle_tpu import autograd  # noqa: F401
 from paddle_tpu.autograd import (  # noqa: F401
     no_grad, enable_grad, set_grad_enabled, is_grad_enabled, grad,

@@ -46,20 +46,33 @@ def _mem_stats(device_id=0):
         return {}
 
 
+def _device_id(device) -> int:
+    """Accept int, 'tpu:3'/'gpu:3' strings, Place, or jax.Device."""
+    if device is None:
+        return 0
+    if isinstance(device, int):
+        return device
+    if isinstance(device, str):
+        return int(device.split(":")[1]) if ":" in device else 0
+    return int(getattr(device, "id", getattr(device, "device_id", 0)))
+
+
 def max_memory_allocated(device=None):
-    return _mem_stats().get("peak_bytes_in_use", 0)
+    return _mem_stats(_device_id(device)).get("peak_bytes_in_use", 0)
 
 
 def max_memory_reserved(device=None):
-    return _mem_stats().get("peak_pool_bytes", max_memory_allocated())
+    return _mem_stats(_device_id(device)).get(
+        "peak_pool_bytes", max_memory_allocated(device))
 
 
 def memory_allocated(device=None):
-    return _mem_stats().get("bytes_in_use", 0)
+    return _mem_stats(_device_id(device)).get("bytes_in_use", 0)
 
 
 def memory_reserved(device=None):
-    return _mem_stats().get("pool_bytes", memory_allocated())
+    return _mem_stats(_device_id(device)).get(
+        "pool_bytes", memory_allocated(device))
 
 
 class cuda:

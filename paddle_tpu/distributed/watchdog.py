@@ -50,15 +50,19 @@ class CollectiveWatchdog:
         return done.wait(self.timeout_s)
 
     def _loop(self):
-        while not self._stop.wait(self.interval_s):
-            if self._probe_once():
-                self.last_ok = time.monotonic()
-            else:
-                self.tripped = True
-                self._dump()
-                if self.on_timeout is not None:
-                    self.on_timeout(self)
-                return
+        try:
+            while not self._stop.wait(self.interval_s):
+                if self._probe_once():
+                    self.last_ok = time.monotonic()
+                else:
+                    self.tripped = True
+                    self._dump()
+                    if self.on_timeout is not None:
+                        self.on_timeout(self)
+                    return
+        finally:
+            # allow a later start() to re-arm monitoring after a trip
+            self._thread = None
 
     def _dump(self):
         print("=" * 60)

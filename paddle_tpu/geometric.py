@@ -77,7 +77,17 @@ def send_ue_recv(x, e, src_index, dst_index, message_op="add",
     n = out_size or (int(np.asarray(dst_index._data).max()) + 1)
     def f(a, ew, src, dst):
         msgs = jnp.take(a, src.astype(jnp.int32), axis=0)
-        msgs = msgs + ew if message_op == "add" else msgs * ew
-        return jax.ops.segment_sum(msgs, dst.astype(jnp.int32),
-                                   num_segments=n)
+        combine = {"add": lambda m, w: m + w, "sub": lambda m, w: m - w,
+                   "mul": lambda m, w: m * w, "div": lambda m, w: m / w}
+        msgs = combine[message_op](msgs, ew)
+        d = dst.astype(jnp.int32)
+        if reduce_op == "mean":
+            s = jax.ops.segment_sum(msgs, d, num_segments=n)
+            cnt = jax.ops.segment_sum(
+                jnp.ones((msgs.shape[0], 1), msgs.dtype), d, num_segments=n)
+            return s / jnp.maximum(cnt, 1)
+        red = {"sum": jax.ops.segment_sum, "add": jax.ops.segment_sum,
+               "max": jax.ops.segment_max,
+               "min": jax.ops.segment_min}[reduce_op]
+        return red(msgs, d, num_segments=n)
     return run_op("send_ue_recv", f, x, e, src_index, dst_index)

@@ -1,6 +1,7 @@
 """Fused functional ops (reference: paddle/incubate/nn/functional)."""
 from __future__ import annotations
 
+import builtins
 import math
 
 import jax
@@ -20,18 +21,80 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
 
 def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
                      begin_norm_axis=-1, **kw):
-    shape = x.shape[begin_norm_axis:] if begin_norm_axis >= 0 else \
-        x.shape[begin_norm_axis:]
+    shape = x.shape[begin_norm_axis:]
     return F.layer_norm(x, shape, norm_weight, norm_bias, epsilon), None
 
 
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
-                                    position_ids=None, use_neox_rotary_style=True):
-    """reference fused_rotary_position_embedding: applies RoPE to q/k
-    ([B, S, H, D] layout)."""
-    from paddle_tpu.models.llama import apply_rotary_pos_emb
-    outs = [apply_rotary_pos_emb(q)]
-    outs.append(apply_rotary_pos_emb(k) if k is not None else None)
+                                    position_ids=None,
+                                    use_neox_rotary_style=True,
+                                    theta=10000.0):
+    """reference fused_rotary_position_embedding ([B, S, H, D] layout).
+
+    sin/cos: optional precomputed tables [1, S, 1, D] (or [S, D]); when
+    absent they are derived from `theta`. position_ids: optional [B, S]
+    absolute positions (KV-cache decode). neox style rotates interleaved
+    even/odd pairs; non-neox rotates the two half-splits.
+    """
+    d = q.shape[-1]
+    seq = q.shape[1]
+    if position_ids is not None:
+        # table must cover the largest absolute position (KV-cache decode
+        # passes positions beyond q's local seq length)
+        pid_arr = position_ids._data if isinstance(position_ids, Tensor) \
+            else jnp.asarray(position_ids)
+        try:
+            seq = builtins.max(seq, int(pid_arr.max()) + 1)
+        except Exception:
+            pass  # traced: caller must supply sin/cos tables instead
+
+    def _tables():
+        if sin is not None and cos is not None:
+            s_t = sin._data if isinstance(sin, Tensor) else jnp.asarray(sin)
+            c_t = cos._data if isinstance(cos, Tensor) else jnp.asarray(cos)
+            if s_t.ndim == 2:                       # [S, D] -> [1, S, 1, D]
+                s_t = s_t[None, :, None, :]
+                c_t = c_t[None, :, None, :]
+            return s_t, c_t
+        inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+        pos = jnp.arange(seq, dtype=jnp.float32)
+        freqs = jnp.outer(pos, inv)                  # [S, D/2]
+        if use_neox_rotary_style:
+            full = jnp.repeat(freqs, 2, axis=-1)     # pair-interleaved
+        else:
+            full = jnp.concatenate([freqs, freqs], -1)   # half-split
+        return (jnp.sin(full)[None, :, None, :],
+                jnp.cos(full)[None, :, None, :])
+
+    s_tab, c_tab = _tables()
+    if position_ids is not None:
+        pid = position_ids._data if isinstance(position_ids, Tensor) \
+            else jnp.asarray(position_ids)
+        # gather rows of the [1, S, 1, D] table per batch -> [B, S, 1, D]
+        s_tab = jnp.take(s_tab[0, :, 0, :], pid, axis=0)[:, :, None, :]
+        c_tab = jnp.take(c_tab[0, :, 0, :], pid, axis=0)[:, :, None, :]
+
+    def rope(a):
+        af = a.astype(jnp.float32)
+        st = s_tab.astype(jnp.float32)
+        ct = c_tab.astype(jnp.float32)
+        if use_neox_rotary_style:
+            x1, x2 = af[..., 0::2], af[..., 1::2]
+            c_h, s_h = ct[..., 0::2], st[..., 0::2]
+            o1 = x1 * c_h - x2 * s_h
+            o2 = x2 * c_h + x1 * s_h
+            out = jnp.stack([o1, o2], axis=-1).reshape(a.shape)
+        else:
+            half = a.shape[-1] // 2
+            x1, x2 = af[..., :half], af[..., half:]
+            c_h, s_h = ct[..., :half], st[..., :half]
+            o1 = x1 * c_h - x2 * s_h
+            o2 = x2 * c_h + x1 * s_h
+            out = jnp.concatenate([o1, o2], axis=-1)
+        return out.astype(a.dtype)
+
+    outs = [run_op("fused_rope", rope, q)]
+    outs.append(run_op("fused_rope", rope, k) if k is not None else None)
     outs.append(v)
     return tuple(outs)
 
@@ -122,14 +185,42 @@ def variable_length_memory_efficient_attention(query, key, value,
                                                kv_seq_lens=None,
                                                mask=None, scale=None,
                                                causal=False):
-    out, _ = F.flash_attn_unpadded(query, key, value, seq_lens,
-                                   kv_seq_lens, None, None, scale=scale,
-                                   causal=causal) \
-        if seq_lens is not None else (None, None)
-    if out is None:
+    """Batched attention with per-sequence valid lengths (reference
+    variable_length_memory_efficient_attention.py): q/k/v are
+    [B, H, S, D]; seq_lens/kv_seq_lens are [B] actual lengths; padded key
+    positions are masked out.
+    """
+    if seq_lens is None:
         return F.scaled_dot_product_attention(query, key, value, mask,
                                               is_causal=causal)
-    return out
+    if kv_seq_lens is None:
+        kv_seq_lens = seq_lens
+
+    def f(q, k, v, q_lens, k_lens, *rest):
+        d = q.shape[-1]
+        s = scale if scale is not None else 1.0 / math.sqrt(d)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * s
+        logits = logits.astype(jnp.float32)
+        sq, sk = q.shape[2], k.shape[2]
+        valid_k = jnp.arange(sk)[None, :] < k_lens[:, None]     # [B, Sk]
+        m = valid_k[:, None, None, :]
+        if causal:
+            m = m & (jnp.arange(sq)[:, None]
+                     >= jnp.arange(sk)[None, :])[None, None]
+        if rest:
+            m = m & (rest[0] if rest[0].dtype == jnp.bool_
+                     else rest[0] > 0)
+        logits = jnp.where(m, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        # zero padded query rows so they can't leak garbage downstream
+        valid_q = jnp.arange(sq)[None, :] < q_lens[:, None]
+        return out * valid_q[:, None, :, None].astype(out.dtype)
+
+    args = [query, key, value, seq_lens, kv_seq_lens]
+    if mask is not None:
+        args.append(mask)
+    return run_op("variable_length_attention", f, *args)
 
 
 def fused_multi_head_attention(x, qkv_weight, linear_weight, **kw):

@@ -61,13 +61,15 @@ class Predictor:
     def __init__(self, config: Config):
         self._config = config
         self._layer = config._layer
-        if self._layer is None and config.model_path:
+        if self._layer is None:
             raise NotImplementedError(
-                "file-based predictor loading requires the Layer class; "
-                "use Config.set_layer(layer) + layer.set_state_dict("
-                "paddle.load(...)) or paddle_tpu.jit.load")
+                "the predictor needs a Layer to serve; use "
+                "Config.set_layer(layer) (+ layer.set_state_dict("
+                "paddle.load(...)) for file-based weights) or "
+                "paddle_tpu.jit.load")
         self._inputs: Dict[str, Tensor] = {}
         self._compiled = None
+        self._last_out: Optional[Tensor] = None
 
     def get_input_names(self):
         return list(self._inputs) or ["x"]
@@ -80,7 +82,9 @@ class Predictor:
         return ["out"]
 
     def get_output_handle(self, name):
-        return _Handle(self._last_out)
+        # late-binding: the handle reads the output produced by the most
+        # recent run(), so it may be fetched before the first run
+        return _OutputHandle(self)
 
     def run(self, inputs: Optional[List[Tensor]] = None):
         args = inputs if inputs is not None else list(self._inputs.values())
@@ -95,6 +99,23 @@ class Predictor:
             out = self._compiled(*args)
         self._last_out = out if isinstance(out, Tensor) else out[0]
         return [self._last_out] if isinstance(out, Tensor) else list(out)
+
+
+class _OutputHandle:
+    """Handle bound to a predictor's latest output (valid after run())."""
+
+    def __init__(self, predictor: "Predictor"):
+        self._p = predictor
+
+    def copy_to_cpu(self):
+        if self._p._last_out is None:
+            raise RuntimeError("no output yet: call Predictor.run() first")
+        return self._p._last_out.numpy()
+
+    def shape(self):
+        if self._p._last_out is None:
+            raise RuntimeError("no output yet: call Predictor.run() first")
+        return self._p._last_out.shape
 
 
 class _Handle:

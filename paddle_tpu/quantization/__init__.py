@@ -71,11 +71,13 @@ class FakeQuanterWithAbsMax(nn.Layer):
 
 
 class QuantedLinear(nn.Layer):
-    def __init__(self, linear: nn.Linear, bit_length=8):
+    def __init__(self, linear: nn.Linear, bit_length=8,
+                 act_quanter=None, weight_quanter=None):
         super().__init__()
         self.inner = linear
-        self.act_quanter = FakeQuanterWithAbsMax(bit_length)
-        self.weight_quanter = FakeQuanterWithAbsMax(bit_length)
+        self.act_quanter = act_quanter or FakeQuanterWithAbsMax(bit_length)
+        self.weight_quanter = weight_quanter or \
+            FakeQuanterWithAbsMax(bit_length)
 
     def forward(self, x):
         from paddle_tpu.nn import functional as F
@@ -85,14 +87,38 @@ class QuantedLinear(nn.Layer):
 
 
 class QuantConfig:
-    def __init__(self, activation=None, weight=None):
+    """activation/weight: optional factory callables returning a quanter
+    layer (reference passes FakeQuanter factories); bit_length applies
+    when the default FakeQuanterWithAbsMax is used."""
+
+    def __init__(self, activation=None, weight=None, bit_length=8):
         self.activation = activation
         self.weight = weight
+        self.bit_length = bit_length
         self._types = (nn.Linear,)
 
     def add_type_config(self, layer_types, activation=None, weight=None):
         self._types = tuple(layer_types) if isinstance(
             layer_types, (list, tuple)) else (layer_types,)
+        if activation is not None:
+            self.activation = activation
+        if weight is not None:
+            self.weight = weight
+
+    def _make_quanted(self, child):
+        return QuantedLinear(
+            child, self.bit_length,
+            act_quanter=self.activation() if callable(self.activation)
+            else None,
+            weight_quanter=self.weight() if callable(self.weight)
+            else None)
+
+
+def _maybe_copy(model, inplace):
+    if inplace:
+        return model
+    import copy
+    return copy.deepcopy(model)
 
 
 class QAT:
@@ -102,11 +128,13 @@ class QAT:
         self.config = config
 
     def quantize(self, model: nn.Layer, inplace=False):
+        model = _maybe_copy(model, inplace)
         for name, layer in list(model.named_sublayers(include_self=True)):
             for cname, child in list(layer._sub_layers.items()):
                 if isinstance(child, self.config._types) and \
                         not isinstance(child, QuantedLinear):
-                    layer.add_sublayer(cname, QuantedLinear(child))
+                    layer.add_sublayer(cname,
+                                       self.config._make_quanted(child))
         return model
 
 
@@ -118,10 +146,11 @@ class PTQ:
         self._observers = {}
 
     def quantize(self, model: nn.Layer, inplace=False):
+        model = _maybe_copy(model, inplace)
         self._hooks = []
         for name, layer in model.named_sublayers(include_self=True):
             if isinstance(layer, self.config._types):
-                obs = AbsmaxObserver()
+                obs = AbsmaxObserver(self.config.bit_length)
                 self._observers[id(layer)] = obs
 
                 def hook(l, inputs, _obs=obs):
@@ -131,14 +160,24 @@ class PTQ:
         return model
 
     def convert(self, model: nn.Layer, inplace=False):
+        # convert must run on the same instance that was observed
+        # (observers are keyed by layer identity); inplace=False returns a
+        # converted deep copy while leaving `model` un-quantized.
         for h in getattr(self, "_hooks", []):
             h.remove()
-        for name, layer in list(model.named_sublayers(include_self=True)):
+        target = _maybe_copy(model, inplace)
+        bits = self.config.bit_length
+        qmax = 2 ** (bits - 1) - 1
+        src_layers = dict(model.named_sublayers(include_self=True))
+        for name, layer in list(target.named_sublayers(include_self=True)):
             for cname, child in list(layer._sub_layers.items()):
-                obs = self._observers.get(id(child))
+                src_parent = src_layers.get(name)
+                src_child = src_parent._sub_layers.get(cname) \
+                    if src_parent is not None else None
+                obs = self._observers.get(id(src_child))
                 if obs is not None:
                     scale = obs.scale()
-                    q = QuantedLinear(child)
+                    q = QuantedLinear(child, bits)
                     q.act_quanter._scale._assign_array(
                         jnp.asarray([scale], jnp.float32))
                     q.act_quanter.eval()
@@ -146,6 +185,6 @@ class PTQ:
                     wmax = float(np.abs(np.asarray(
                         child.weight._data)).max())
                     q.weight_quanter._scale._assign_array(
-                        jnp.asarray([wmax / 127.0], jnp.float32))
+                        jnp.asarray([wmax / qmax], jnp.float32))
                     layer.add_sublayer(cname, q)
-        return model
+        return target

@@ -12,14 +12,44 @@ from paddle_tpu.core.tensor import Tensor
 
 
 class SparseCooTensor(Tensor):
-    """Tensor whose storage is a BCOO sparse array."""
+    """Tensor whose storage is a BCOO sparse array.
+
+    Dense materialization is LAZY: `_data` densifies only when a dense op
+    actually touches it (the reference keeps COO storage until a dense
+    kernel is selected; densifying eagerly would OOM on large sparse
+    tensors).
+    """
 
     @classmethod
     def _wrap_bcoo(cls, bcoo, stop_gradient=True):
         t = cls.__new__(cls)
-        t._init_from_array(bcoo.todense(), stop_gradient)
+        t._init_from_array(None, stop_gradient)
         t._bcoo = bcoo
         return t
+
+    @property
+    def _data(self):
+        d = Tensor._data.__get__(self)
+        if d is None:
+            d = self._bcoo.todense()
+            Tensor._data.__set__(self, d)
+        return d
+
+    @_data.setter
+    def _data(self, value):
+        Tensor._data.__set__(self, value)
+
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def ndim(self):
+        return self._bcoo.ndim
+
+    @property
+    def dtype(self):
+        return np.dtype(self._bcoo.data.dtype)
 
     def indices(self):
         return Tensor._wrap(self._bcoo.indices.T)

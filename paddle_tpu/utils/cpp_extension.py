@@ -88,8 +88,9 @@ def load(name: str, sources: List[str], extra_cxx_cflags: List[str] = None,
     build_dir = build_directory or os.path.join(
         os.path.expanduser("~/.cache/paddle_tpu_extensions"), name)
     os.makedirs(build_dir, exist_ok=True)
-    tag = hashlib.md5("".join(
-        open(s).read() for s in sources).encode()).hexdigest()[:12]
+    key = "".join(open(s).read() for s in sources) + \
+        repr(extra_cxx_cflags) + repr(extra_ldflags)
+    tag = hashlib.md5(key.encode()).hexdigest()[:12]
     so = os.path.join(build_dir, f"{name}_{tag}.so")
     if not os.path.exists(so):
         cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17"] + \
@@ -97,13 +98,20 @@ def load(name: str, sources: List[str], extra_cxx_cflags: List[str] = None,
             (extra_ldflags or [])
         if verbose:
             print("[cpp_extension]", " ".join(cmd))
-        subprocess.run(cmd, check=True, capture_output=not verbose)
+        proc = subprocess.run(cmd, capture_output=not verbose)
+        if proc.returncode != 0:
+            err = (proc.stderr or b"").decode(errors="replace") \
+                if proc.stderr else "(see console output above)"
+            raise RuntimeError(
+                f"cpp_extension build of '{name}' failed "
+                f"(exit {proc.returncode}):\n{err}")
     return ctypes.CDLL(so)
 
 
 class CppExtension:
-    def __init__(self, sources, **kwargs):
+    def __init__(self, sources, name=None, **kwargs):
         self.sources = sources
+        self.name = name
         self.kwargs = kwargs
 
 
@@ -118,7 +126,10 @@ def setup(name=None, ext_modules=None, **kwargs):
     """paddle.utils.cpp_extension.setup analog: builds each CppExtension
     immediately (JIT) rather than via setuptools."""
     libs = {}
-    for ext in (ext_modules if isinstance(ext_modules, (list, tuple))
-                else [ext_modules]):
-        libs[name] = load(name, ext.sources, **ext.kwargs)
+    exts = ext_modules if isinstance(ext_modules, (list, tuple)) \
+        else [ext_modules]
+    for i, ext in enumerate(exts):
+        ext_name = ext.name or (name if len(exts) == 1
+                                else f"{name}_{i}")
+        libs[ext_name] = load(ext_name, ext.sources, **ext.kwargs)
     return libs

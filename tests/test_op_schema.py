@@ -1,0 +1,170 @@
+"""Op schema registry tests (SURVEY §2.2): ops.yaml ↔ op library ↔ _C_ops
+conformance, and InferMeta functions vs XLA abstract evaluation.
+
+Reference mechanism being mirrored: the yaml is the single source of truth
+(paddle/phi/ops/yaml/ops.yaml) and generated surfaces must stay in sync
+(python_c_gen.py); infermeta shape fns must agree with kernel semantics
+(phi/infermeta tested by OpTest shape checks).
+"""
+import importlib
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.ops.yaml.generator import generate, load_schemas, load_compat
+from paddle_tpu.core import infermeta as im
+
+
+@pytest.fixture(scope="module")
+def schemas():
+    return load_schemas()
+
+
+def test_every_kernel_resolves(schemas):
+    for s in schemas:
+        path = s["kernel"]["func"]
+        mod, fn = path.rsplit(".", 1)
+        obj = getattr(importlib.import_module(mod), fn, None)
+        assert callable(obj), f"kernel {path} does not resolve"
+
+
+def test_inplace_variants_exist(schemas):
+    for s in schemas:
+        if "inplace" not in s:
+            continue
+        path = s["kernel"]["func"]
+        mod, fn = path.rsplit(".", 1)
+        obj = getattr(importlib.import_module(mod), fn + "_", None)
+        assert callable(obj), f"declared inplace {fn}_ missing in {mod}"
+
+
+def test_infermeta_func_resolves(schemas):
+    for s in schemas:
+        fname = s["infer_meta"]["func"]
+        assert hasattr(im, fname), f"infermeta fn {fname} missing"
+
+
+def test_generated_c_ops_up_to_date():
+    import paddle_tpu
+    gen = generate()
+    path = importlib.import_module("paddle_tpu._C_ops").__file__
+    with open(path) as f:
+        assert f.read() == gen, "_C_ops.py stale: rerun generator"
+
+
+def test_compat_aliases_bound():
+    import paddle_tpu._C_ops as C
+    for op, legacy in load_compat().items():
+        assert hasattr(C, legacy), legacy
+        assert getattr(C, legacy) is getattr(C, op)
+
+
+def test_c_ops_callable_smoke():
+    import paddle_tpu as pd
+    import paddle_tpu._C_ops as C
+    x = pd.Tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    np.testing.assert_allclose(C.add(x, x).numpy(), x.numpy() * 2)
+    np.testing.assert_allclose(
+        C.matmul(x, x.T).numpy(), x.numpy() @ x.numpy().T, rtol=1e-6)
+    assert C.reshape(x, [3, 2]).shape == [3, 2]
+
+
+# ---------------------------------------------------------------- infermeta
+
+def M(shape, dtype=np.float32):
+    return im.MetaTensor(shape, dtype)
+
+
+def test_broadcast_shape():
+    assert im.broadcast_shape((2, 1, 3), (4, 3)) == (2, 4, 3)
+    with pytest.raises(ValueError):
+        im.broadcast_shape((2, 3), (4,))
+
+
+@pytest.mark.parametrize("a,b,kw", [
+    ((2, 3), (3, 4), {}),
+    ((5, 2, 3), (3, 4), {}),
+    ((5, 2, 3), (5, 3, 4), {}),
+    ((3,), (3, 4), {}),
+    ((2, 3), (3,), {}),
+    ((2, 3), (2, 4), {"transpose_x": True}),
+    ((2, 3), (4, 3), {"transpose_y": True}),
+])
+def test_matmul_infermeta_matches_eval_shape(a, b, kw):
+    got = im.matmul_infermeta(M(a), M(b), **kw)
+    want = im.infer_via_eval_shape(
+        lambda p, q: jnp.matmul(
+            jnp.swapaxes(p, -1, -2) if kw.get("transpose_x") and p.ndim > 1
+            else p,
+            jnp.swapaxes(q, -1, -2) if kw.get("transpose_y") and q.ndim > 1
+            else q),
+        M(a), M(b))
+    assert got == want
+
+
+@pytest.mark.parametrize("shape,target", [
+    ((2, 3, 4), (6, 4)), ((2, 3, 4), (-1,)), ((2, 3, 4), (0, -1)),
+    ((6,), (2, 3)),
+])
+def test_reshape_infermeta(shape, target):
+    got = im.reshape_infermeta(M(shape), target)
+    # emulate the 0/-1 resolution numpy-side
+    t = list(target)
+    for i, s in enumerate(t):
+        if s == 0:
+            t[i] = shape[i]
+    want = np.zeros(shape).reshape(t).shape
+    assert got.shape == want
+
+
+def test_reduce_infermeta():
+    assert im.reduce_infermeta(M((2, 3, 4)), axis=1).shape == (2, 4)
+    assert im.reduce_infermeta(M((2, 3, 4)), axis=(0, 2),
+                               keepdim=True).shape == (1, 3, 1)
+    assert im.reduce_infermeta(M((2, 3)), axis=None).shape == ()
+
+
+def test_concat_split_stack():
+    assert im.concat_infermeta([M((2, 3)), M((4, 3))], 0).shape == (6, 3)
+    assert im.stack_infermeta([M((2, 3))] * 4, 1).shape == (2, 4, 3)
+    outs = im.split_infermeta(M((6, 3)), 3, 0)
+    assert [o.shape for o in outs] == [(2, 3)] * 3
+    outs = im.split_infermeta(M((6, 3)), [1, 2, 3], 0)
+    assert [o.shape for o in outs] == [(1, 3), (2, 3), (3, 3)]
+
+
+def test_conv_pool_infermeta_match_jax():
+    import jax
+    x, w = M((2, 3, 16, 16)), M((8, 3, 3, 3))
+    got = im.conv2d_infermeta(x, w, stride=2, padding=1)
+    out = jax.eval_shape(
+        lambda a, b: jax.lax.conv_general_dilated(
+            a, b, (2, 2), [(1, 1), (1, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW")),
+        jax.ShapeDtypeStruct(x.shape, x.dtype),
+        jax.ShapeDtypeStruct(w.shape, w.dtype))
+    assert got.shape == out.shape
+    assert im.pool2d_infermeta(M((2, 3, 16, 16)), 2, 2).shape == (2, 3, 8, 8)
+
+
+def test_elementwise_promotion():
+    got = im.elementwise_infermeta(M((2, 3), np.float32),
+                                   M((3,), np.float64))
+    assert got.shape == (2, 3) and got.dtype == np.float64
+
+
+def test_transpose_expand_tile_pad():
+    assert im.transpose_infermeta(M((2, 3, 4)), (2, 0, 1)).shape == (4, 2, 3)
+    assert im.expand_infermeta(M((1, 3)), (5, -1)).shape == (5, 3)
+    assert im.tile_infermeta(M((2, 3)), (2,)).shape == (2, 6)
+    assert im.pad_infermeta(M((2, 3)), [1, 1, 0, 2]).shape == (4, 5)
+
+
+def test_embedding_gather_where():
+    assert im.embedding_infermeta(M((4, 7), np.int64),
+                                  M((100, 16))).shape == (4, 7, 16)
+    assert im.gather_infermeta(M((5, 3)), M((7,), np.int64), 0).shape \
+        == (7, 3)
+    assert im.where_infermeta(M((2, 1), np.bool_), M((2, 3)),
+                              M((3,))).shape == (2, 3)

@@ -105,7 +105,11 @@ def test_quantization_qat_and_ptq():
     m = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 4))
     x = paddle.randn([4, 8])
     ref = m(x)
-    qat_model = QAT(QuantConfig()).quantize(m)
+    qat_model = QAT(QuantConfig()).quantize(m, inplace=False)
+    from paddle_tpu.quantization import QuantedLinear
+    # inplace=False: original model untouched
+    assert not isinstance(m[0], QuantedLinear)
+    assert isinstance(qat_model[0], QuantedLinear)
     out = qat_model(x)
     assert out.shape == [4, 4]
     # quantized forward should be close-ish but not exact
@@ -113,16 +117,20 @@ def test_quantization_qat_and_ptq():
     # QAT still trains
     loss = out.sum()
     loss.backward()
-    assert m[0].inner.weight.grad is not None
+    assert qat_model[0].inner.weight.grad is not None
 
     m2 = nn.Sequential(nn.Linear(8, 4))
     ptq = PTQ(QuantConfig())
-    ptq.quantize(m2)
+    ptq.quantize(m2, inplace=True)
     for _ in range(3):
         m2(paddle.randn([4, 8]))
-    ptq.convert(m2)
-    out2 = m2(x)
+    m2q = ptq.convert(m2, inplace=False)
+    assert not isinstance(m2[0], QuantedLinear)  # original preserved
+    assert isinstance(m2q[0], QuantedLinear)
+    out2 = m2q(x)
     assert out2.shape == [4, 4]
+    # int8 PTQ on a small net stays close to fp32
+    assert np.abs(out2.numpy() - m2(x).numpy()).max() < 0.5
 
 
 def test_inference_predictor():
