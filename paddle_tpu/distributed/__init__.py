@@ -26,6 +26,7 @@ from .api import (  # noqa: F401
     dtensor_from_local, reshard, shard_layer, shard_optimizer, shard_tensor,
 )
 from .parallel import DataParallel  # noqa: F401
+from paddle_tpu.native import TCPStore  # noqa: F401
 from . import fleet  # noqa: F401
 from .fleet.recompute import recompute  # noqa: F401
 

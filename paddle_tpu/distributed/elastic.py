@@ -16,6 +16,22 @@ import time
 from typing import Callable, Dict, List, Optional
 
 
+def _wrap_ttl(value: str, ttl_s: Optional[float]) -> str:
+    return json.dumps({"value": value,
+                       "expires": time.time() + ttl_s if ttl_s else None})
+
+
+def _unwrap_ttl(raw) -> Optional[str]:
+    """Decoded value, or None if malformed/expired."""
+    try:
+        payload = json.loads(raw)
+    except (json.JSONDecodeError, UnicodeDecodeError, TypeError):
+        return None
+    if payload.get("expires") and payload["expires"] < time.time():
+        return None
+    return payload["value"]
+
+
 class KVStore:
     """Pluggable store interface (etcd analog)."""
 
@@ -40,11 +56,9 @@ class FileKVStore(KVStore):
         return os.path.join(self.root, key.replace("/", "__"))
 
     def put(self, key, value, ttl_s=None):
-        payload = {"value": value,
-                   "expires": time.time() + ttl_s if ttl_s else None}
         tmp = self._path(key) + ".tmp"
         with open(tmp, "w") as f:
-            json.dump(payload, f)
+            f.write(_wrap_ttl(value, ttl_s))
         os.replace(tmp, self._path(key))
 
     def get_prefix(self, prefix):
@@ -55,12 +69,12 @@ class FileKVStore(KVStore):
                 continue
             try:
                 with open(os.path.join(self.root, fn)) as f:
-                    payload = json.load(f)
-            except (json.JSONDecodeError, OSError):
+                    raw = f.read()
+            except OSError:
                 continue
-            if payload.get("expires") and payload["expires"] < time.time():
-                continue
-            out[fn.replace("__", "/")] = payload["value"]
+            value = _unwrap_ttl(raw)
+            if value is not None:
+                out[fn.replace("__", "/")] = value
         return out
 
     def delete(self, key):
@@ -68,6 +82,31 @@ class FileKVStore(KVStore):
             os.remove(self._path(key))
         except FileNotFoundError:
             pass
+
+
+class TCPKVStore(KVStore):
+    """KVStore over the native C++ TCPStore (native/src/store.cc) — the
+    in-cluster etcd stand-in when no shared filesystem exists. TTLs are
+    enforced read-side from an expiry stamp in the payload, matching
+    FileKVStore semantics."""
+
+    def __init__(self, host: str, port: int, is_master: bool = False):
+        from paddle_tpu.native import TCPStore
+        self._store = TCPStore(host, port, is_master=is_master)
+
+    def put(self, key, value, ttl_s=None):
+        self._store.set(key, _wrap_ttl(value, ttl_s))
+
+    def get_prefix(self, prefix):
+        out = {}
+        for key, raw in self._store.list(prefix).items():
+            value = _unwrap_ttl(raw)
+            if value is not None:
+                out[key] = value
+        return out
+
+    def delete(self, key):
+        self._store.delete_key(key)
 
 
 class ElasticManager:

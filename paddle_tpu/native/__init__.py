@@ -14,33 +14,44 @@ import threading
 import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "src", "paddle_native.cc")
+_SRC_DIR = os.path.join(_DIR, "src")
 _SO = os.path.join(_DIR, "libpaddle_native.so")
 
+
+def _sources():
+    return sorted(
+        os.path.join(_SRC_DIR, f) for f in os.listdir(_SRC_DIR)
+        if f.endswith(".cc"))
+
+
 _lib = None
+_lib_failed = False  # cache build/load failure: don't retry every call
 _lock = threading.Lock()
 
 
 def _build():
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-           _SRC, "-o", _SO]
+           *_sources(), "-o", _SO]
     subprocess.run(cmd, check=True, capture_output=True)
 
 
 def get_lib():
     """Load (building if needed) the native library; None if unavailable."""
-    global _lib
-    if _lib is not None:
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
         return _lib
     with _lock:
-        if _lib is not None:
+        if _lib is not None or _lib_failed:
             return _lib
         try:
-            if not os.path.exists(_SO) or \
-                    os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            srcs = _sources()
+            if not os.path.exists(_SO) or any(
+                    os.path.getmtime(_SO) < os.path.getmtime(s)
+                    for s in srcs):
                 _build()
             lib = ctypes.CDLL(_SO)
         except Exception:
+            _lib_failed = True
             return None
         lib.pn_collate.argtypes = [
             ctypes.POINTER(ctypes.c_void_p), ctypes.c_int64,
@@ -64,6 +75,52 @@ def get_lib():
         lib.pn_queue_pop.restype = ctypes.c_int64
         lib.pn_queue_size.argtypes = [ctypes.c_void_p]
         lib.pn_queue_size.restype = ctypes.c_int64
+        # --- TCP store ---
+        lib.pn_store_server_start.restype = ctypes.c_void_p
+        lib.pn_store_server_start.argtypes = [ctypes.c_int32]
+        lib.pn_store_server_stop.argtypes = [ctypes.c_void_p]
+        lib.pn_store_connect.restype = ctypes.c_void_p
+        lib.pn_store_connect.argtypes = [ctypes.c_char_p, ctypes.c_int32,
+                                         ctypes.c_int32]
+        lib.pn_store_client_close.argtypes = [ctypes.c_void_p]
+        lib.pn_store_set.restype = ctypes.c_int32
+        lib.pn_store_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_void_p, ctypes.c_int64]
+        lib.pn_store_get.restype = ctypes.c_int64
+        lib.pn_store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_void_p, ctypes.c_int64,
+                                     ctypes.c_int64]
+        lib.pn_store_add.restype = ctypes.c_int64
+        lib.pn_store_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int64]
+        lib.pn_store_check.restype = ctypes.c_int32
+        lib.pn_store_check.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.pn_store_delete.restype = ctypes.c_int32
+        lib.pn_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.pn_store_list.restype = ctypes.c_int64
+        lib.pn_store_list.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int32)]
+        # --- host tracer ---
+        lib.pn_prof_enable.argtypes = [ctypes.c_int32]
+        lib.pn_prof_enabled.restype = ctypes.c_int32
+        lib.pn_prof_begin.argtypes = [ctypes.c_char_p]
+        lib.pn_prof_record.argtypes = [ctypes.c_char_p, ctypes.c_double,
+                                       ctypes.c_double]
+        lib.pn_prof_count.restype = ctypes.c_int64
+        lib.pn_prof_get.restype = ctypes.c_int64
+        lib.pn_prof_get.argtypes = [
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_int64)]
+        # --- stats registry ---
+        lib.pn_stat_update.restype = ctypes.c_int64
+        lib.pn_stat_update.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.pn_stat_current.restype = ctypes.c_int64
+        lib.pn_stat_current.argtypes = [ctypes.c_char_p]
+        lib.pn_stat_peak.restype = ctypes.c_int64
+        lib.pn_stat_peak.argtypes = [ctypes.c_char_p]
+        lib.pn_stat_reset_peak.argtypes = [ctypes.c_char_p]
         _lib = lib
         return _lib
 
@@ -166,3 +223,219 @@ class BlockingQueue:
                 self._handle = None
         except Exception:
             pass
+
+
+# --------------------------------------------------------------------------
+class TCPStore:
+    """Native TCP rendezvous key-value store.
+
+    Reference: phi/core/distributed/store/tcp_store.h:121 — the
+    master/worker KV store used for bootstrap, endpoint exchange and
+    host-level barriers. The master rank also runs the server thread.
+    Values are bytes; `add` maintains int64 counters (mirrored into the
+    KV space so `wait`/`get` can observe them).
+    """
+
+    def __init__(self, host: str, port: int, is_master: bool = False,
+                 world_size: int = 1, timeout: float = 90.0):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable; TCPStore "
+                               "requires the C++ runtime")
+        self._lib = lib
+        self.host = host
+        self.port = port
+        self.is_master = is_master
+        self.world_size = world_size
+        self.timeout = timeout
+        self._server = None
+        self._client = None
+        self._barrier_seq = {}
+        if is_master:
+            self._server = lib.pn_store_server_start(port)
+            if not self._server:
+                raise RuntimeError(f"TCPStore: cannot bind port {port}")
+        self._client = lib.pn_store_connect(
+            host.encode(), port, int(timeout * 1000))
+        if not self._client:
+            if self._server:
+                lib.pn_store_server_stop(self._server)
+                self._server = None
+            raise RuntimeError(f"TCPStore: cannot connect {host}:{port}")
+
+    def set(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        buf = (ctypes.c_char * len(value)).from_buffer_copy(value) \
+            if value else None
+        ok = self._lib.pn_store_set(self._client, key.encode(), buf,
+                                    len(value))
+        if not ok:
+            raise RuntimeError("TCPStore.set failed")
+
+    def get(self, key: str, timeout: float = None) -> bytes:
+        """Blocking get: waits until `key` is set (reference wait+get)."""
+        tmo = int((self.timeout if timeout is None else timeout) * 1000)
+        cap = 1 << 16
+        while True:
+            out = ctypes.create_string_buffer(cap)
+            n = self._lib.pn_store_get(self._client, key.encode(), out,
+                                       cap, tmo)
+            if n == -2:
+                cap *= 16
+                continue
+            if n < 0:
+                raise TimeoutError(f"TCPStore.get({key!r}) timed out")
+            return out.raw[:n]
+
+    def add(self, key: str, delta: int = 1) -> int:
+        v = self._lib.pn_store_add(self._client, key.encode(), delta)
+        if v == -(2 ** 63):
+            raise RuntimeError("TCPStore.add failed")
+        return v
+
+    def check(self, key: str) -> bool:
+        return self._lib.pn_store_check(self._client, key.encode()) == 1
+
+    def delete_key(self, key: str) -> bool:
+        return bool(self._lib.pn_store_delete(self._client, key.encode()))
+
+    def list(self, prefix: str = "") -> dict:
+        """All (key, value) pairs whose key starts with `prefix`."""
+        cap = 1 << 16
+        while True:
+            out = ctypes.create_string_buffer(cap)
+            count = ctypes.c_int32()
+            n = self._lib.pn_store_list(self._client, prefix.encode(), out,
+                                        cap, ctypes.byref(count))
+            if n == -2:
+                cap *= 16
+                continue
+            if n < 0:
+                raise RuntimeError("TCPStore.list failed")
+            buf, off, res = out.raw, 0, {}
+            import struct
+            for _ in range(count.value):
+                klen = struct.unpack_from("<I", buf, off)[0]
+                off += 4
+                key = buf[off:off + klen].decode()
+                off += klen
+                vlen = struct.unpack_from("<Q", buf, off)[0]
+                off += 8
+                res[key] = buf[off:off + vlen]
+                off += vlen
+            return res
+
+    def wait(self, keys, timeout: float = None) -> None:
+        if isinstance(keys, str):
+            keys = [keys]
+        for k in keys:
+            self.get(k, timeout=timeout)
+
+    def barrier(self, tag: str = "default", timeout: float = None) -> None:
+        """Host barrier over the store: arrive-count + release key.
+
+        Reusable: each call advances a local per-tag sequence number (all
+        ranks call barrier the same number of times, so sequences agree)
+        and synchronizes on generation-specific keys.
+        """
+        seq = self._barrier_seq.get(tag, 0)
+        self._barrier_seq[tag] = seq + 1
+        n = self.add(f"__barrier/{tag}/{seq}/arrived", 1)
+        if n == self.world_size:
+            self.set(f"__barrier/{tag}/{seq}/release", b"1")
+        self.get(f"__barrier/{tag}/{seq}/release", timeout=timeout)
+
+    def close(self):
+        if getattr(self, "_client", None):
+            self._lib.pn_store_client_close(self._client)
+            self._client = None
+        if getattr(self, "_server", None):
+            self._lib.pn_store_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# --------------------------------------------------------------------------
+# Host tracer (native RecordEvent span buffer).
+
+def tracer_enable(on: bool = True) -> bool:
+    lib = get_lib()
+    if lib is None:
+        return False
+    lib.pn_prof_enable(1 if on else 0)
+    return True
+
+
+def tracer_clear():
+    lib = get_lib()
+    if lib is not None:
+        lib.pn_prof_clear()
+
+
+def tracer_begin(name: str):
+    lib = get_lib()
+    if lib is not None:
+        lib.pn_prof_begin(name.encode())
+
+
+def tracer_end():
+    lib = get_lib()
+    if lib is not None:
+        lib.pn_prof_end()
+
+
+def tracer_record(name: str, start_us: float, dur_us: float):
+    lib = get_lib()
+    if lib is not None:
+        lib.pn_prof_record(name.encode(), start_us, dur_us)
+
+
+def tracer_spans():
+    """Drain recorded spans -> list of (name, start_us, dur_us, tid)."""
+    lib = get_lib()
+    if lib is None:
+        return []
+    n = lib.pn_prof_count()
+    out = []
+    name = ctypes.create_string_buffer(512)
+    start = ctypes.c_double()
+    dur = ctypes.c_double()
+    tid = ctypes.c_int64()
+    for i in range(n):
+        if lib.pn_prof_get(i, name, 512, ctypes.byref(start),
+                           ctypes.byref(dur), ctypes.byref(tid)) >= 0:
+            out.append((name.value.decode(errors="replace"), start.value,
+                        dur.value, tid.value))
+    return out
+
+
+# --------------------------------------------------------------------------
+# Stats registry (memory/stats.cc analog).
+
+def stat_update(key: str, delta: int) -> int:
+    lib = get_lib()
+    if lib is None:
+        return 0
+    return lib.pn_stat_update(key.encode(), delta)
+
+
+def stat_current(key: str) -> int:
+    lib = get_lib()
+    return 0 if lib is None else lib.pn_stat_current(key.encode())
+
+
+def stat_peak(key: str) -> int:
+    lib = get_lib()
+    return 0 if lib is None else lib.pn_stat_peak(key.encode())
+
+
+def stat_reset_peak(key: str):
+    lib = get_lib()
+    if lib is not None:
+        lib.pn_stat_reset_peak(key.encode())

@@ -22,6 +22,7 @@ from typing import Callable, Dict, List, Optional
 
 import jax
 
+from paddle_tpu import native as _native
 from paddle_tpu.core import dispatch as _dispatch
 
 
@@ -67,20 +68,35 @@ _TRACER = _HostTracer()
 
 
 class RecordEvent:
-    """Host-span marker (reference platform::RecordEvent)."""
+    """Host-span marker (reference platform::RecordEvent).
+
+    Spans go to the native C++ tracer ring (native/src/tracer.cc,
+    HostTracer analog) when the native runtime is built; Python-side
+    buffer otherwise.
+    """
 
     def __init__(self, name: str, event_type=None):
         self.name = name
         self._t0 = None
+        self._native = False
 
     def begin(self):
+        # Availability is only probed while the tracer is enabled, so the
+        # common profiler-off hot path never triggers the native build.
+        if _TRACER.enabled:
+            self._native = _native.available()
+            if self._native:
+                _native.tracer_begin(self.name)
         self._t0 = time.perf_counter_ns() / 1e3
 
     def end(self):
         if self._t0 is not None and _TRACER.enabled:
-            _TRACER.add(_Span(self.name, self._t0,
-                              time.perf_counter_ns() / 1e3,
-                              threading.get_ident() % 100000))
+            if self._native:
+                _native.tracer_end()
+            else:
+                _TRACER.add(_Span(self.name, self._t0,
+                                  time.perf_counter_ns() / 1e3,
+                                  threading.get_ident() % 100000))
         self._t0 = None
 
     def __enter__(self):
@@ -149,6 +165,9 @@ class Profiler:
         if not self.timer_only:
             _TRACER.enabled = True
             _TRACER.clear()
+            if _native.available():
+                _native.tracer_clear()
+                _native.tracer_enable(True)
             self._hook_ops()
             try:
                 self._xprof_dir = os.environ.get(
@@ -162,6 +181,8 @@ class Profiler:
     def stop(self):
         if not self.timer_only:
             _TRACER.enabled = False
+            if _native.available():
+                _native.tracer_enable(False)
             if self._op_unhook:
                 self._op_unhook()
                 self._op_unhook = None
@@ -201,9 +222,16 @@ class Profiler:
         self._op_unhook = _dispatch.add_op_observer(cb)
 
     # ---- export / stats ----
+    def _all_spans(self):
+        """Python-buffer spans + native-tracer spans, unified."""
+        spans = list(_TRACER.spans)
+        for name, start, dur, tid in _native.tracer_spans():
+            spans.append(_Span(name, start, start + dur, tid))
+        return spans
+
     def _export_chrome(self, path):
         events = []
-        for s in _TRACER.spans:
+        for s in self._all_spans():
             events.append({
                 "name": s.name, "ph": "X", "ts": s.start_us,
                 "dur": max(s.end_us - s.start_us, 0.001),
@@ -218,7 +246,7 @@ class Profiler:
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
         agg: Dict[str, List[float]] = {}
-        for s in _TRACER.spans:
+        for s in self._all_spans():
             agg.setdefault(s.name, []).append(s.end_us - s.start_us)
         lines = [f"{'name':<40}{'calls':>8}{'total(us)':>12}"]
         for name, durs in sorted(agg.items(),

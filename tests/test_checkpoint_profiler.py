@@ -85,9 +85,11 @@ def test_launcher_cpu_sim(tmp_path):
     import sys
     script = tmp_path / "worker.py"
     script.write_text(
-        "import os\n"
-        "print('rank', os.environ['PADDLE_TRAINER_ID'],\n"
-        "      'world', os.environ['PADDLE_TRAINERS_NUM'])\n")
+        "import os, sys\n"
+        # single atomic write: the two ranks' stdout interleaves otherwise
+        "sys.stdout.write('rank %s world %s\\n' % (\n"
+        "    os.environ['PADDLE_TRAINER_ID'],\n"
+        "    os.environ['PADDLE_TRAINERS_NUM']))\n")
     res = subprocess.run(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
          "--nproc_per_node", "2", str(script)],

@@ -88,3 +88,152 @@ def test_dataloader_uses_native_collate():
     batches = list(DataLoader(DS(), batch_size=4))
     assert batches[0][0].shape == [4, 64, 64]
     assert float(batches[0][0][1, 0, 0]) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# TCPStore (native/src/store.cc; reference tcp_store.h:121 semantics)
+# ---------------------------------------------------------------------------
+def _free_port():
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_tcp_store_kv_counters_barrier(lib):
+    port = _free_port()
+    master = native.TCPStore("127.0.0.1", port, is_master=True,
+                             world_size=3)
+    results = {}
+
+    def worker(rank):
+        c = native.TCPStore("127.0.0.1", port, world_size=3)
+        c.set(f"ep/{rank}", f"host{rank}")
+        c.add("count", 1)
+        c.barrier("b")
+        results[rank] = c.list("ep/")
+        c.close()
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in (1, 2)]
+    for t in ts:
+        t.start()
+    master.set("ep/0", "host0")
+    master.add("count", 1)
+    master.barrier("b")
+    for t in ts:
+        t.join()
+    # after barrier every participant saw every endpoint
+    assert master.list("ep/") == {f"ep/{r}": f"host{r}".encode()
+                                  for r in range(3)}
+    for r in (1, 2):
+        assert len(results[r]) == 3
+    assert int(master.get("count")) == 3
+    assert master.check("ep/1") and not master.check("missing")
+    master.delete_key("ep/1")
+    assert not master.check("ep/1")
+    master.close()
+
+
+def test_tcp_store_blocking_get_and_timeout(lib):
+    port = _free_port()
+    master = native.TCPStore("127.0.0.1", port, is_master=True)
+    got = {}
+
+    def late_setter():
+        import time
+        time.sleep(0.15)
+        c = native.TCPStore("127.0.0.1", port)
+        c.set("late", b"\x01\x02")
+        c.close()
+
+    t = threading.Thread(target=late_setter)
+    t.start()
+    got["v"] = master.get("late", timeout=5)  # blocks until set
+    t.join()
+    assert got["v"] == b"\x01\x02"
+    with pytest.raises(TimeoutError):
+        master.get("never", timeout=0.1)
+    master.close()
+
+
+def test_elastic_tcp_kv_store(lib):
+    from paddle_tpu.distributed.elastic import TCPKVStore
+    port = _free_port()
+    kv = TCPKVStore("127.0.0.1", port, is_master=True)
+    kv.put("job/nodes/0", "a:1")
+    kv.put("job/nodes/1", "b:2", ttl_s=600)
+    kv.put("job/nodes/2", "c:3", ttl_s=-1)  # already expired
+    got = kv.get_prefix("job/nodes/")
+    assert got == {"job/nodes/0": "a:1", "job/nodes/1": "b:2"}
+    kv.delete("job/nodes/0")
+    assert "job/nodes/0" not in kv.get_prefix("job/nodes/")
+
+
+# ---------------------------------------------------------------------------
+# Host tracer + stats registry (native/src/tracer.cc)
+# ---------------------------------------------------------------------------
+def test_native_tracer_nested_spans(lib):
+    native.tracer_clear()
+    native.tracer_enable(True)
+    native.tracer_begin("outer")
+    native.tracer_begin("inner")
+    native.tracer_end()
+    native.tracer_end()
+    native.tracer_enable(False)
+    spans = {s[0]: s for s in native.tracer_spans()}
+    assert set(spans) >= {"outer", "inner"}
+    # inner nests within outer on the same thread
+    assert spans["inner"][1] >= spans["outer"][1]
+    assert spans["inner"][2] <= spans["outer"][2]
+    assert spans["inner"][3] == spans["outer"][3]
+
+
+def test_profiler_uses_native_tracer(lib):
+    import paddle_tpu.profiler as profiler
+    with profiler.Profiler() as p:
+        with profiler.RecordEvent("step_span"):
+            pass
+    assert any(s.name == "step_span" for s in p._all_spans())
+
+
+def test_stats_registry(lib):
+    native.stat_update("test/alloc", 1000)
+    native.stat_update("test/alloc", 500)
+    native.stat_update("test/alloc", -1200)
+    assert native.stat_current("test/alloc") == 300
+    assert native.stat_peak("test/alloc") == 1500
+    native.stat_reset_peak("test/alloc")
+    assert native.stat_peak("test/alloc") == 300
+
+
+def test_tcp_store_barrier_is_reusable(lib):
+    port = _free_port()
+    master = native.TCPStore("127.0.0.1", port, is_master=True,
+                             world_size=2)
+    order = []
+
+    def worker():
+        c = native.TCPStore("127.0.0.1", port, world_size=2)
+        for i in range(3):
+            c.barrier("loop")
+            order.append(("w", i))
+        c.close()
+
+    t = threading.Thread(target=worker)
+    t.start()
+    for i in range(3):
+        master.barrier("loop")
+        order.append(("m", i))
+    t.join()
+    # every generation completed on both sides
+    assert sorted(order) == [("m", 0), ("m", 1), ("m", 2),
+                             ("w", 0), ("w", 1), ("w", 2)]
+    master.close()
+
+
+def test_tcp_store_hostname_resolution(lib):
+    port = _free_port()
+    master = native.TCPStore("localhost", port, is_master=True)
+    master.set("k", b"v")
+    assert master.get("k") == b"v"
+    master.close()
