@@ -27,6 +27,11 @@ from .api import (  # noqa: F401
 )
 from .parallel import DataParallel  # noqa: F401
 from paddle_tpu.native import TCPStore  # noqa: F401
+from . import sharding  # noqa: F401
+from .sharding import (  # noqa: F401
+    DygraphShardingOptimizer, GroupShardedScaler, GroupShardedStage2,
+    GroupShardedStage3, group_sharded_parallel, save_group_sharded_model,
+)
 from . import fleet  # noqa: F401
 from .fleet.recompute import recompute  # noqa: F401
 

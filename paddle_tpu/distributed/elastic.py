@@ -27,6 +27,8 @@ def _unwrap_ttl(raw) -> Optional[str]:
         payload = json.loads(raw)
     except (json.JSONDecodeError, UnicodeDecodeError, TypeError):
         return None
+    if not isinstance(payload, dict) or "value" not in payload:
+        return None  # e.g. raw counters the store mirrors into kv space
     if payload.get("expires") and payload["expires"] < time.time():
         return None
     return payload["value"]

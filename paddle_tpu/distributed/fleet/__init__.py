@@ -21,6 +21,10 @@ from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
                         get_rng_state_tracker, model_parallel_random_seed)
 from .recompute import recompute, recompute_sequential
 from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc
+from .sequence_parallel_utils import (
+    ColumnSequenceParallelLinear, RowSequenceParallelLinear,
+    mark_as_sequence_parallel_parameter,
+    register_sequence_parallel_allreduce_hooks)
 
 __all__ = [
     "init", "DistributedStrategy", "distributed_model",
@@ -28,6 +32,9 @@ __all__ = [
     "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
     "ParallelCrossEntropy", "get_rng_state_tracker", "recompute",
     "LayerDesc", "PipelineLayer",
+    "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+    "mark_as_sequence_parallel_parameter",
+    "register_sequence_parallel_allreduce_hooks",
 ]
 
 

@@ -1,0 +1,143 @@
+"""Megatron-style sequence parallelism tied to TP.
+
+Reference: fleet/utils/sequence_parallel_utils.py —
+ScatterOp/GatherOp/AllGatherOp/ReduceScatterOp PyLayers (:85-137),
+ColumnSequenceParallelLinear (:427) with allgather/GEMM overlap (:255),
+RowSequenceParallelLinear, mark_as_sequence_parallel_parameter +
+register_sequence_parallel_allreduce_hooks (:192).
+
+TPU-native: between TP blocks, activations are sharded on the *sequence*
+dim over the same 'mp' axis the weights use. The Column linear's
+"allgather input then GEMM" and the Row linear's "GEMM then
+reduce-scatter output" are expressed as sharding constraints; GSPMD
+emits the allgather/reduce-scatter pair and overlaps it with the
+matmuls (the overlap the reference hand-rolls at :255). The SP-param
+grad allreduce hooks (:192) have no analog here: gradients of
+replicated params used under sharded activations already come out of
+the compiled backward globally reduced.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.core.dispatch import run_op
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer.layers import Layer
+from .mp_layers import _constrain, _mp_axis, _put
+
+
+def _seq_spec(ndim, seq_dim=1):
+    spec = [None] * ndim
+    spec[seq_dim] = "mp"
+    return P(*spec)
+
+
+def scatter(x, seq_dim: int = 1):
+    """Replicated -> sequence-sharded over 'mp' (ScatterOp :85)."""
+    return run_op("sp_scatter",
+                  lambda a: _constrain(a, _seq_spec(a.ndim, seq_dim)), x)
+
+
+def all_gather(x, seq_dim: int = 1):
+    """Sequence-sharded -> replicated (AllGatherOp :107)."""
+    return run_op("sp_all_gather",
+                  lambda a: _constrain(a, P(*([None] * a.ndim))), x)
+
+
+def reduce_scatter(x, seq_dim: int = 1):
+    """Partial-sum -> sequence-sharded (ReduceScatterOp :127). With
+    GSPMD the pending partial-sum never materializes; constraining the
+    producer's output to the seq-sharded spec yields a reduce-scatter."""
+    return scatter(x, seq_dim)
+
+
+def mark_as_sequence_parallel_parameter(param):
+    """Reference :176 tags params whose grads need the SP allreduce.
+    Kept for API parity; the compiled backward already reduces them."""
+    param.sequence_parallel = True
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_grad=False):
+    """Reference :192. No-op on TPU: XLA's partitioner inserts the grad
+    reduction for replicated params under sequence-sharded activations."""
+    return model
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """Input arrives sequence-sharded [B, S/mp, in]; it is allgathered
+    (by constraint) and hit with the column-sharded weight, leaving the
+    output TP-sharded on features (reference :427)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, mp_group=None,
+                 name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            (in_features, out_features), weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.is_distributed = True
+        self.bias = self.create_parameter(
+            (out_features,), None, is_bias=True) if has_bias else None
+        _put(self.weight, P(None, "mp"))
+        if self.bias is not None:
+            self.bias.is_distributed = True
+            _put(self.bias, P("mp"))
+
+    def forward(self, x):
+        def f(a, w, *b):
+            a = _constrain(a, P(*([None] * a.ndim)))  # seq allgather
+            out = jnp.matmul(a, w)
+            if b:
+                out = out + b[0]
+            spec = [None] * out.ndim
+            if not self.gather_output:
+                spec[-1] = "mp"
+            return _constrain(out, P(*spec))
+        args = (x, self.weight) + ((self.bias,) if self.bias is not None
+                                   else ())
+        return run_op("column_seq_parallel_linear", f, *args)
+
+
+class RowSequenceParallelLinear(Layer):
+    """Input is TP-sharded on features [B, S, in/mp]; the contraction's
+    partial sums are reduce-scattered straight into the sequence-sharded
+    output [B, S/mp, out] (reference RowSequenceParallelLinear)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, mp_group=None,
+                 name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            (in_features, out_features), weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.is_distributed = True
+        self.bias = self.create_parameter(
+            (out_features,), None, is_bias=True) if has_bias else None
+        _put(self.weight, P("mp", None))
+
+    def forward(self, x):
+        def f(a, w, *b):
+            if self.input_is_parallel:
+                a = _constrain(a, P(*([None] * (a.ndim - 1) + ["mp"])))
+            out = jnp.matmul(a, w)
+            out = _constrain(out, _seq_spec(out.ndim, 1))  # reduce-scatter
+            if b:
+                out = out + b[0]
+            return out
+        args = (x, self.weight) + ((self.bias,) if self.bias is not None
+                                   else ())
+        return run_op("row_seq_parallel_linear", f, *args)
+
+
+GatherOp = all_gather
+ScatterOp = scatter
+AllGatherOp = all_gather
+ReduceScatterOp = reduce_scatter

@@ -1,0 +1,263 @@
+"""Group-sharded data parallelism (ZeRO stages 1/2/3) — manual fleet API.
+
+Reference surface being provided (SURVEY §2.7 sharding rows):
+  - paddle.distributed.sharding.group_sharded_parallel
+    (python/paddle/distributed/sharding/group_sharded.py)
+  - DygraphShardingOptimizer
+    (fleet/meta_optimizers/dygraph_optimizer/dygraph_sharding_optimizer.py:48)
+  - GroupShardedStage2 (fleet/meta_parallel/sharding/group_sharded_stage2.py:46)
+  - GroupShardedStage3 (group_sharded_stage3.py:85)
+  - save_group_sharded_model
+
+TPU-native design — the reference's machinery maps onto GSPMD shardings
+instead of streams/buckets:
+
+  stage 1 (os):    optimizer states live dp/sharding-axis sharded; the
+                   rank-local update + param broadcast the reference does
+                   by hand is XLA's sharded-update + allgather.
+  stage 2 (os_g):  + gradients are *stored* sharded. The reference
+                   reduce-scatters grads into rank slices from backward
+                   hooks; here a post-accumulation hook re-lays the
+                   accumulated grad onto the sharded spec, so XLA keeps
+                   only the local slice (under jit the sharding
+                   constraint makes the psum a reduce-scatter).
+  stage 3 (p_g_os): + parameters themselves sharded. The reference
+                   allgathers params pre-forward and releases them
+                   post-backward with stream events; GSPMD inserts the
+                   allgather at each use point and its DCE releases the
+                   gathered copy — same memory shape, no hand scheduling.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer.layers import Layer
+from .mesh import ProcessMesh, get_mesh, set_mesh
+
+
+def _resolve_axis(group=None):
+    """(mesh, axis_name) for the sharding group: the fleet 'sharding'
+    axis when present, else 'dp', else first axis of a 1-axis mesh over
+    all devices."""
+    mesh = getattr(group, "process_mesh", None) or get_mesh()
+    if mesh is None:
+        n = len(jax.devices())
+        mesh = ProcessMesh(shape=[n], dim_names=["dp"])
+        set_mesh(mesh)
+    for name in ("sharding", "dp"):
+        if name in mesh.dim_names and mesh.get_dim_size(name) > 1:
+            return mesh, name
+    return mesh, mesh.dim_names[0]
+
+
+def _shard_spec(shape, mesh, axis):
+    """PartitionSpec sharding the largest divisible dim over `axis`,
+    or None if nothing divides (small tensors stay replicated)."""
+    n = mesh.get_dim_size(axis)
+    if not shape or n <= 1:
+        return None
+    order = sorted(range(len(shape)), key=lambda d: -shape[d])
+    for dim in order:
+        if shape[dim] % n == 0 and shape[dim] >= n:
+            spec = [None] * len(shape)
+            spec[dim] = axis
+            return PartitionSpec(*spec)
+    return None
+
+
+class DygraphShardingOptimizer:
+    """Stage-1 sharded optimizer (reference
+    dygraph_sharding_optimizer.py:48). Accumulators are created lazily by
+    the inner optimizer; after each step's creation they are re-laid
+    sharded over the group axis so each rank stores 1/N of the optimizer
+    state. Master weights (AMP O2) follow the same placement."""
+
+    def __init__(self, optimizer, hcg=None, group=None):
+        self._inner_opt = optimizer
+        self._mesh, self._axis = _resolve_axis(group)
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def _shard_states(self):
+        mesh = self._mesh
+        for _, d in getattr(self._inner_opt, "_accumulators", {}).items():
+            for _, acc in d.items():
+                spec = _shard_spec(acc._data.shape, mesh, self._axis)
+                if spec is None:
+                    continue
+                sh = NamedSharding(mesh.jax_mesh, spec)
+                if getattr(acc._data, "sharding", None) != sh:
+                    acc._data = jax.device_put(acc._data, sh)
+        mw = getattr(self._inner_opt, "_master_weights", None)
+        if isinstance(mw, dict):
+            for _, w in mw.items():
+                spec = _shard_spec(w._data.shape, mesh, self._axis)
+                if spec is not None:
+                    sh = NamedSharding(mesh.jax_mesh, spec)
+                    if getattr(w._data, "sharding", None) != sh:
+                        w._data = jax.device_put(w._data, sh)
+
+    def step(self):
+        if hasattr(self._inner_opt, "_create_accumulators"):
+            self._inner_opt._create_accumulators()
+        self._shard_states()
+        self._inner_opt.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner_opt.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, state):
+        return self._inner_opt.set_state_dict(state)
+
+
+class GroupShardedStage2(Layer):
+    """Stage-2 wrapper (reference group_sharded_stage2.py:46): gradients
+    are stored group-axis-sharded. A post-accumulation hook on every
+    trainable param re-lays `param.grad` onto the sharded spec the moment
+    backward finishes accumulating it, releasing the replicated copy —
+    the reduce-scatter the reference fires from its grad hooks."""
+
+    def __init__(self, layer: Layer, optimizer=None, group=None,
+                 sync_buffers=False, buffer_max_size=2 ** 23,
+                 auto_refresh_trainable=True, device="tpu",
+                 dp_group=None):
+        super().__init__()
+        self._layers = layer
+        self._mesh, self._axis = _resolve_axis(group)
+        for _, p in layer.named_parameters():
+            if p.stop_gradient:
+                continue
+            p._register_backward_hook(self._reshard_grad)
+
+    def _reshard_grad(self, leaf: Tensor):
+        g = leaf.grad
+        if g is None:
+            return
+        spec = _shard_spec(g._data.shape, self._mesh, self._axis)
+        if spec is None:
+            return
+        sh = NamedSharding(self._mesh.jax_mesh, spec)
+        if getattr(g._data, "sharding", None) != sh:
+            g._data = jax.device_put(g._data, sh)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def named_parameters(self, *a, **k):
+        return self._layers.named_parameters(*a, **k)
+
+    def get_all_parameters(self):
+        """Reference API: materialize full (replicated) params."""
+        rep = NamedSharding(self._mesh.jax_mesh, PartitionSpec())
+        for _, p in self._layers.named_parameters():
+            p._assign_array(jax.device_put(p._data, rep))
+
+
+class GroupShardedStage3(GroupShardedStage2):
+    """Stage-3 wrapper (reference group_sharded_stage3.py:85): parameters
+    sharded over the group axis at wrap time. XLA allgathers each param
+    at its use point inside the compiled step (the reference's pre-forward
+    allgather) and frees the gathered buffer after last use (the
+    reference's post-backward release)."""
+
+    def __init__(self, layer: Layer, optimizer=None, group=None,
+                 sync_buffers=False, device="tpu", segment_size=2 ** 20,
+                 pertrain_sync_models=True, offload=False, sync_comm=False,
+                 dp_group=None, exclude_layer=None):
+        super().__init__(layer, optimizer=optimizer, group=group,
+                         sync_buffers=sync_buffers, dp_group=dp_group)
+        for _, p in layer.named_parameters():
+            spec = _shard_spec(p._data.shape, self._mesh, self._axis)
+            if spec is None:
+                continue
+            p._assign_array(jax.device_put(
+                p._data, NamedSharding(self._mesh.jax_mesh, spec)))
+
+
+class GroupShardedScaler:
+    """Reference group_sharded_utils.GroupShardedScaler: wraps GradScaler.
+    The cross-rank found_inf allreduce it adds is unnecessary here — the
+    finite-check reduction runs over the (sharded) global grads inside
+    XLA, which emits the collective."""
+
+    def __init__(self, scaler):
+        self._scaler = scaler
+
+    def __getattr__(self, item):
+        return getattr(self._scaler, item)
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None,
+                           group=None, offload=False, sync_buffers=False,
+                           buffer_max_size=2 ** 23, segment_size=2 ** 20,
+                           sync_comm=False, dp_group=None,
+                           exclude_layer=None):
+    """reference group_sharded.py:33 — wrap (model, optimizer, scaler)
+    for ZeRO level 'os' | 'os_g' | 'p_g_os'."""
+    if level not in ("os", "os_g", "p_g_os"):
+        raise ValueError("level must be one of 'os', 'os_g', 'p_g_os'")
+    optimizer = DygraphShardingOptimizer(optimizer, group=group)
+    if level == "os_g":
+        model = GroupShardedStage2(model, optimizer, group=group,
+                                   sync_buffers=sync_buffers,
+                                   buffer_max_size=buffer_max_size,
+                                   dp_group=dp_group)
+    elif level == "p_g_os":
+        model = GroupShardedStage3(model, optimizer, group=group,
+                                   sync_buffers=sync_buffers,
+                                   segment_size=segment_size,
+                                   offload=offload, sync_comm=sync_comm,
+                                   dp_group=dp_group,
+                                   exclude_layer=exclude_layer)
+    if scaler is not None:
+        scaler = GroupShardedScaler(scaler)
+    return model, optimizer, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """reference group_sharded.py save_group_sharded_model: gather the
+    sharded params to full tensors and save a plain state_dict."""
+    import os
+
+    from paddle_tpu.framework import io as fio
+
+    if isinstance(model, GroupShardedStage2):
+        inner, mesh = model._layers, model._mesh
+    else:
+        inner, mesh = model, get_mesh()
+    state = {}
+    for name, p in inner.state_dict().items():
+        arr = p._data if isinstance(p, Tensor) else p
+        if getattr(arr, "sharding", None) is not None:
+            if mesh is not None:
+                arr = jax.device_put(
+                    arr, NamedSharding(mesh.jax_mesh, PartitionSpec()))
+            else:
+                arr = jax.numpy.asarray(np.asarray(arr))
+        state[name] = Tensor._wrap(arr, True) if not isinstance(p, Tensor) \
+            else Tensor._wrap(arr, p.stop_gradient)
+    os.makedirs(output, exist_ok=True)
+    fio.save(state, os.path.join(output, "model.pdmodel"))
+    if optimizer is not None:
+        fio.save(optimizer.state_dict(),
+                 os.path.join(output, "model.pdopt"))

@@ -344,6 +344,11 @@ class TCPStore:
         n = self.add(f"__barrier/{tag}/{seq}/arrived", 1)
         if n == self.world_size:
             self.set(f"__barrier/{tag}/{seq}/release", b"1")
+            if seq > 0:
+                # last arriver garbage-collects the previous generation
+                # (everyone passed it to get here), bounding store growth
+                self.delete_key(f"__barrier/{tag}/{seq - 1}/arrived")
+                self.delete_key(f"__barrier/{tag}/{seq - 1}/release")
         self.get(f"__barrier/{tag}/{seq}/release", timeout=timeout)
 
     def close(self):

@@ -7,7 +7,12 @@ pipelining over the 'pp' axis via ppermute, and sequence-parallel sharding
 helpers.
 """
 from .ring_attention import ring_attention  # noqa: F401
+from .ulysses import ulysses_attention, ulysses_attention_sharded  # noqa: F401
 from .pipeline import pipeline_apply, stack_stage_params  # noqa: F401
+from .pp_schedule import (  # noqa: F401
+    PipeOp, Schedule, run_schedule, schedule_1f1b, schedule_fthenb,
+    schedule_interleaved, schedule_zbh1,
+)
 from .sequence import (  # noqa: F401
     shard_sequence, gather_sequence, sequence_parallel_enabled,
 )

@@ -1,0 +1,323 @@
+"""Pipeline-parallel schedule descriptors: F-then-B, 1F1B, interleaved
+virtual-pipeline (VPP), and zero-bubble ZBH1.
+
+Reference being re-designed (SURVEY §2.7 PP row / §2.7 distributed
+passes): the pipeline scheduler passes
+(distributed/passes/pipeline_scheduler_pass/{pipeline_1f1b,pipeline_vpp,
+pipeline_zero_bubble}.py) and the host loops in
+fleet/meta_parallel/pipeline_parallel.py:547 (1F1B) and :1143
+(interleaved). There, a schedule is a list of Jobs executed per rank by
+the fleet executor. Here, a Schedule is the same thing made explicit and
+testable: per-stage ordered instruction lists over (kind, stage,
+microbatch, chunk) cells, with
+
+  - a dependency simulator (`simulate`) that validates the order is
+    executable (the reference trusts its generators; we check) and
+    reports makespan/bubble fraction, and
+  - a host executor (`run_schedule`) that runs real compute per cell —
+    the eager analog of PirInterpreter executing a Plan's job list.
+
+On TPU the *compiled* pipeline (paddle_tpu.parallel.pipeline) fuses all
+of this into one XLA program; these descriptors serve the host-driven
+path (heterogeneous stages, eager debugging) and schedule analysis.
+
+Zero-bubble note: ZBH1 (pipeline_zero_bubble.py:62) splits backward into
+B (input-grad, on the critical path) and W (weight-grad, fills bubbles).
+That split is exactly a vjp whose weight-cotangent computation is
+deferred — functionally trivial here, stream-juggling in CUDA land.
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+from typing import Callable, Dict, List, Optional, Tuple
+
+# One instruction cell. kind: F (forward), B (backward input-grad; in
+# non-zero-bubble schedules also computes weight-grad), W (deferred
+# weight-grad, zero-bubble only). chunk = virtual-stage index (VPP).
+PipeOp = namedtuple("PipeOp", ["kind", "stage", "mb", "chunk"])
+PipeOp.__new__.__defaults__ = (0,)
+
+
+class Schedule:
+    """Per-stage ordered op lists + cost model."""
+
+    def __init__(self, name: str, n_stages: int, n_microbatches: int,
+                 per_stage: List[List[PipeOp]], n_chunks: int = 1,
+                 durations: Optional[Dict[str, float]] = None):
+        self.name = name
+        self.n_stages = n_stages
+        self.n_microbatches = n_microbatches
+        self.n_chunks = n_chunks
+        self.per_stage = per_stage
+        # F=1; a fused backward (dgrad+wgrad) costs 2; split B and W cost
+        # 1 each — the standard zero-bubble accounting.
+        self.durations = durations or (
+            {"F": 1.0, "B": 1.0, "W": 1.0} if self._has_w()
+            else {"F": 1.0, "B": 2.0})
+
+    def _has_w(self):
+        return any(op.kind == "W" for ops in self.per_stage for op in ops)
+
+    # -- dependency model ---------------------------------------------
+    def deps(self, op: PipeOp) -> List[PipeOp]:
+        """Cross-stage + intra-cell dependencies of one cell."""
+        n, v = self.n_stages, self.n_chunks
+        out = []
+        if op.kind == "F":
+            if op.stage > 0:
+                out.append(PipeOp("F", op.stage - 1, op.mb, op.chunk))
+            elif op.chunk > 0:
+                # interleaved wrap: chunk c of stage 0 consumes chunk c-1
+                # of the last stage
+                out.append(PipeOp("F", n - 1, op.mb, op.chunk - 1))
+        elif op.kind == "B":
+            out.append(PipeOp("F", op.stage, op.mb, op.chunk))
+            if op.stage < n - 1:
+                out.append(PipeOp("B", op.stage + 1, op.mb, op.chunk))
+            elif op.chunk < v - 1:
+                out.append(PipeOp("B", 0, op.mb, op.chunk + 1))
+        elif op.kind == "W":
+            out.append(PipeOp("B", op.stage, op.mb, op.chunk))
+        return out
+
+    # -- validation / cost --------------------------------------------
+    def simulate(self) -> Tuple[float, float]:
+        """Event-driven execution respecting per-stage order + deps.
+
+        Returns (makespan, bubble_fraction). Raises on deadlock (invalid
+        schedule) or on ops missing from the schedule.
+        """
+        ptr = [0] * self.n_stages
+        stage_free = [0.0] * self.n_stages
+        done: Dict[PipeOp, float] = {}
+        total = sum(len(ops) for ops in self.per_stage)
+        n_done = 0
+        while n_done < total:
+            progressed = False
+            for s in range(self.n_stages):
+                while ptr[s] < len(self.per_stage[s]):
+                    op = self.per_stage[s][ptr[s]]
+                    if any(d not in done for d in self.deps(op)):
+                        break
+                    start = max([stage_free[s]] +
+                                [done[d] for d in self.deps(op)])
+                    end = start + self.durations[op.kind]
+                    done[op] = end
+                    stage_free[s] = end
+                    ptr[s] += 1
+                    n_done += 1
+                    progressed = True
+            if not progressed:
+                stuck = [self.per_stage[s][ptr[s]]
+                         for s in range(self.n_stages)
+                         if ptr[s] < len(self.per_stage[s])]
+                raise RuntimeError(
+                    f"schedule {self.name!r} deadlocked at {stuck}")
+        makespan = max(done.values())
+        work = max(sum(self.durations[op.kind] for op in ops)
+                   for ops in self.per_stage)
+        return makespan, 1.0 - work / makespan
+
+    def peak_activations(self) -> int:
+        """Max number of live forward contexts on any stage (the memory
+        axis on which 1F1B beats F-then-B). A context becomes live at F
+        and is freed at the matching B — unless a deferred W cell exists
+        for it (zero-bubble), which holds the context until W runs;
+        that's ZB's known memory premium over 1F1B."""
+        peak = 0
+        for ops in self.per_stage:
+            has_w = {(op.mb, op.chunk) for op in ops if op.kind == "W"}
+            live = 0
+            for op in ops:
+                if op.kind == "F":
+                    live += 1
+                elif op.kind == "B" and (op.mb, op.chunk) not in has_w:
+                    live -= 1
+                elif op.kind == "W":
+                    live -= 1
+                peak = max(peak, live)
+        return peak
+
+    def __repr__(self):
+        return (f"Schedule({self.name}, stages={self.n_stages}, "
+                f"mb={self.n_microbatches}, chunks={self.n_chunks})")
+
+
+# ---------------------------------------------------------------------
+# Generators
+# ---------------------------------------------------------------------
+
+def schedule_fthenb(n_stages: int, n_microbatches: int) -> Schedule:
+    """GPipe F-then-B (reference pipeline_scheduler_pass FThenB): all
+    forwards, then all backwards. Peak activation memory = all M."""
+    per_stage = []
+    for s in range(n_stages):
+        ops = [PipeOp("F", s, i) for i in range(n_microbatches)]
+        ops += [PipeOp("B", s, i) for i in range(n_microbatches)]
+        per_stage.append(ops)
+    return Schedule("FThenB", n_stages, n_microbatches, per_stage)
+
+
+def schedule_1f1b(n_stages: int, n_microbatches: int) -> Schedule:
+    """1F1B (pipeline_parallel.py:547): warmup of (stages-1-s) forwards,
+    steady-state alternation, cooldown. Peak live activations per stage
+    <= stages, independent of M."""
+    per_stage = []
+    for s in range(n_stages):
+        w = min(n_stages - 1 - s, n_microbatches)
+        ops = [PipeOp("F", s, i) for i in range(w)]
+        for i in range(n_microbatches - w):
+            ops.append(PipeOp("F", s, w + i))
+            ops.append(PipeOp("B", s, i))
+        for i in range(n_microbatches - w, n_microbatches):
+            ops.append(PipeOp("B", s, i))
+        per_stage.append(ops)
+    return Schedule("1F1B", n_stages, n_microbatches, per_stage)
+
+
+def schedule_zbh1(n_stages: int, n_microbatches: int) -> Schedule:
+    """Zero-bubble ZBH1 (pipeline_zero_bubble.py:62): 1F1B shape with
+    backward split into B (critical path) and W (bubble filler). W for
+    microbatch i is scheduled at the point 1F1B would have spent the
+    second half of its fused backward, except during cooldown where W's
+    are deferred to fill the tail bubble."""
+    per_stage = []
+    for s in range(n_stages):
+        w = min(n_stages - 1 - s, n_microbatches)
+        ops = [PipeOp("F", s, i) for i in range(w)]
+        pending_w: List[PipeOp] = []
+        for i in range(n_microbatches - w):
+            ops.append(PipeOp("F", s, w + i))
+            ops.append(PipeOp("B", s, i))
+            # steady state: immediately retire the weight grad unless we
+            # are in the first `s` steady slots, where deferring it lets
+            # the B chain start earlier on downstream stages
+            if i < s:
+                pending_w.append(PipeOp("W", s, i))
+            else:
+                ops.append(PipeOp("W", s, i))
+        for i in range(n_microbatches - w, n_microbatches):
+            ops.append(PipeOp("B", s, i))
+            pending_w.append(PipeOp("W", s, i))
+        ops += pending_w
+        per_stage.append(ops)
+    return Schedule("ZBH1", n_stages, n_microbatches, per_stage)
+
+
+def schedule_interleaved(n_stages: int, n_microbatches: int,
+                         n_chunks: int) -> Schedule:
+    """Interleaved VPP (pipeline_parallel.py:1143 /
+    pipeline_vpp.py): each physical stage holds `n_chunks` virtual
+    stages; microbatches stream through chunk 0 of all stages, then
+    chunk 1, etc. Generated greedily against the dependency model with
+    the Megatron policy (depth-first forwards in warmup, then 1F1B
+    alternation), so the order is valid by construction."""
+    if n_microbatches % n_stages != 0:
+        raise ValueError("interleaved schedule needs microbatches % "
+                         "stages == 0 (reference constraint)")
+    total_f = n_microbatches * n_chunks
+    # per-stage warmup length (Megatron formula)
+    per_stage: List[List[PipeOp]] = []
+    f_order = []  # global virtual-forward order per stage policy
+    for k in range(total_f):
+        grp, pos = divmod(k, n_stages * n_chunks)
+        chunk, slot = divmod(pos, n_stages)
+        f_order.append((grp * n_stages + slot, chunk))
+    for s in range(n_stages):
+        warmup = min((n_stages - s - 1) * 2 + (n_chunks - 1) * n_stages,
+                     total_f)
+        fs = [PipeOp("F", s, mb, c) for mb, c in f_order]
+        bs = [PipeOp("B", s, mb, c) for mb, c in
+              [(mb, n_chunks - 1 - c) for mb, c in f_order]]
+        ops = fs[:warmup]
+        fi, bi = warmup, 0
+        while fi < total_f or bi < total_f:
+            if fi < total_f:
+                ops.append(fs[fi])
+                fi += 1
+            if bi < total_f:
+                ops.append(bs[bi])
+                bi += 1
+        per_stage.append(ops)
+    return Schedule(f"VPP{n_chunks}", n_stages, n_microbatches, per_stage,
+                    n_chunks=n_chunks)
+
+
+# ---------------------------------------------------------------------
+# Host executor (eager Plan interpreter)
+# ---------------------------------------------------------------------
+
+def run_schedule(sched: Schedule, forward: Callable, backward: Callable,
+                 weight_grad: Optional[Callable], microbatch_inputs,
+                 loss_grads):
+    """Execute a schedule's cells with real compute.
+
+    forward(stage, chunk, x) -> (y, ctx)
+    backward(stage, chunk, ctx, gy) -> gx          (input-grad only)
+    weight_grad(stage, chunk, ctx, gy) -> None     (accumulates; ZB only;
+        pass None to fold weight grads into `backward`)
+    microbatch_inputs: list of M inputs to (stage0, chunk0)
+    loss_grads: list of M output-cotangents seeded at the last virtual
+        stage (stage n-1, chunk v-1)
+
+    Executes cells in a valid global order (round-robin over stages
+    honoring per-stage order + readiness, like the simulator). Returns
+    the list of final-stage outputs per microbatch.
+    """
+    if weight_grad is not None and not sched._has_w():
+        raise ValueError(
+            f"schedule {sched.name!r} has no W cells; with a split "
+            "weight_grad callback the weight grads would silently never "
+            "be computed — use a zero-bubble schedule or fold weight "
+            "grads into `backward` and pass weight_grad=None")
+    acts: Dict[Tuple[int, int, int], object] = {}   # F outputs
+    ctxs: Dict[Tuple[int, int, int], object] = {}
+    grads: Dict[Tuple[int, int, int], object] = {}  # B input-grads
+    outs: Dict[int, object] = {}
+    n, v = sched.n_stages, sched.n_chunks
+    done = set()
+    ptr = [0] * n
+    total = sum(len(ops) for ops in sched.per_stage)
+    n_done = 0
+    while n_done < total:
+        progressed = False
+        for s in range(n):
+            while ptr[s] < len(sched.per_stage[s]):
+                op = sched.per_stage[s][ptr[s]]
+                if any(d not in done for d in sched.deps(op)):
+                    break
+                key = (op.stage, op.mb, op.chunk)
+                if op.kind == "F":
+                    if op.stage == 0 and op.chunk == 0:
+                        x = microbatch_inputs[op.mb]
+                    elif op.stage == 0:
+                        x = acts[(n - 1, op.mb, op.chunk - 1)]
+                    else:
+                        x = acts[(op.stage - 1, op.mb, op.chunk)]
+                    y, ctx = forward(op.stage, op.chunk, x)
+                    acts[key] = y
+                    ctxs[key] = ctx
+                    if op.stage == n - 1 and op.chunk == v - 1:
+                        outs[op.mb] = y
+                elif op.kind == "B":
+                    if op.stage == n - 1 and op.chunk == v - 1:
+                        gy = loss_grads[op.mb]
+                    elif op.stage == n - 1:
+                        gy = grads[(0, op.mb, op.chunk + 1)]
+                    else:
+                        gy = grads[(op.stage + 1, op.mb, op.chunk)]
+                    gx = backward(op.stage, op.chunk, ctxs[key], gy)
+                    grads[key] = gx
+                    if weight_grad is not None:
+                        # stash gy for the W cell
+                        ctxs[key] = (ctxs[key], gy)
+                else:  # W
+                    ctx, gy = ctxs[key]
+                    weight_grad(op.stage, op.chunk, ctx, gy)
+                done.add(op)
+                ptr[s] += 1
+                n_done += 1
+                progressed = True
+        if not progressed:
+            raise RuntimeError(f"run_schedule deadlocked in {sched.name}")
+    return [outs[i] for i in range(sched.n_microbatches)]

@@ -90,10 +90,14 @@ class RecordEvent:
         self._t0 = time.perf_counter_ns() / 1e3
 
     def end(self):
-        if self._t0 is not None and _TRACER.enabled:
+        if self._t0 is not None:
             if self._native:
+                # always pop the native span stack once begin() pushed,
+                # even if the tracer was disabled mid-span — an unmatched
+                # entry would corrupt later spans on this thread
                 _native.tracer_end()
-            else:
+                self._native = False
+            elif _TRACER.enabled:
                 _TRACER.add(_Span(self.name, self._t0,
                                   time.perf_counter_ns() / 1e3,
                                   threading.get_ident() % 100000))
