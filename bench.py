@@ -53,14 +53,17 @@ def main():
                                           devices=jax.devices()[:1])
     rng = np.random.RandomState(0)
     ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    # NOTE: sync via scalar readback (float(loss)), not block_until_ready —
+    # the tunneled PJRT backend acks block_until_ready before the device
+    # actually finishes; a host readback is the only true barrier there.
     with mesh:
         for _ in range(warmup):
             params, opt_state, loss = step(params, opt_state, (ids, ids))
-        loss.block_until_ready()
+        float(loss)
         t0 = time.perf_counter()
         for _ in range(steps):
             params, opt_state, loss = step(params, opt_state, (ids, ids))
-        loss.block_until_ready()
+        float(loss)
         dt = time.perf_counter() - t0
 
     tokens_per_sec = batch * seq * steps / dt

@@ -441,3 +441,20 @@ def _kl_uniform(p, q):
         res = jnp.log((qh - ql) / (ph - pl))
         return jnp.where((ql <= pl) & (ph <= qh), res, jnp.inf)
     return run_op("kl_uniform", f, p.low, p.high, q.low, q.high)
+
+
+# --------------------------------------------------------------------------
+# Extended families + transform library (separate modules, reference file
+# layout: python/paddle/distribution/{poisson,binomial,...,transform}.py)
+from .families import (  # noqa: E402,F401
+    Binomial, Cauchy, Chi2, ContinuousBernoulli, ExponentialFamily,
+    Independent, LKJCholesky, MultivariateNormal, Poisson, StudentT,
+    TransformedDistribution,
+)
+from . import transform  # noqa: E402,F401
+from .transform import (  # noqa: E402,F401
+    AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform,
+    SigmoidTransform, SoftmaxTransform, StackTransform,
+    StickBreakingTransform, TanhTransform, Transform,
+)

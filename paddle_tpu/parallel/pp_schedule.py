@@ -254,8 +254,10 @@ def run_schedule(sched: Schedule, forward: Callable, backward: Callable,
 
     forward(stage, chunk, x) -> (y, ctx)
     backward(stage, chunk, ctx, gy) -> gx          (input-grad only)
-    weight_grad(stage, chunk, ctx, gy) -> None     (accumulates; ZB only;
-        pass None to fold weight grads into `backward`)
+    weight_grad(stage, chunk, ctx, gy) -> None     (accumulates weight
+        grads; required for zero-bubble schedules with W cells. For
+        schedules without W cells pass None and fold weight grads into
+        `backward`; mismatches in either direction raise.)
     microbatch_inputs: list of M inputs to (stage0, chunk0)
     loss_grads: list of M output-cotangents seeded at the last virtual
         stage (stage n-1, chunk v-1)
@@ -270,6 +272,11 @@ def run_schedule(sched: Schedule, forward: Callable, backward: Callable,
             "weight_grad callback the weight grads would silently never "
             "be computed — use a zero-bubble schedule or fold weight "
             "grads into `backward` and pass weight_grad=None")
+    if weight_grad is None and sched._has_w():
+        raise ValueError(
+            f"schedule {sched.name!r} contains W cells; pass a "
+            "weight_grad callback (zero-bubble splits backward into "
+            "input-grad B and weight-grad W)")
     acts: Dict[Tuple[int, int, int], object] = {}   # F outputs
     ctxs: Dict[Tuple[int, int, int], object] = {}
     grads: Dict[Tuple[int, int, int], object] = {}  # B input-grads

@@ -131,3 +131,11 @@ def test_schedule_numerics_match_autodiff(maker, split_wgrad, n_virtual):
     for got, exp in zip(wgrads, expect):
         np.testing.assert_allclose(np.asarray(got), np.asarray(exp),
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_run_schedule_rejects_mismatched_weight_grad():
+    ws, xs = _problem(N_STAGES)
+    with pytest.raises(ValueError, match="W cells"):
+        _run(schedule_1f1b(N_STAGES, N_MB), ws, xs, split_wgrad=True)
+    with pytest.raises(ValueError, match="weight_grad"):
+        _run(schedule_zbh1(N_STAGES, N_MB), ws, xs, split_wgrad=False)
