@@ -1,0 +1,35 @@
+import time, math
+import numpy as np
+import jax, jax.numpy as jnp
+from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes, flash_attention as _fa
+
+b, s, h, d = 8, 1024, 16, 64
+rng = np.random.RandomState(0)
+qt = jnp.asarray(rng.randn(b, h, s, d), jnp.bfloat16)
+READBACK = None
+
+def timeit(blk, label, iters=50):
+    global READBACK
+    try:
+        def attn(q, k, v):
+            return _fa(q, k, v, causal=True, sm_scale=1/math.sqrt(d), block_sizes=blk)
+        g = jax.jit(jax.grad(lambda q,k,v: attn(q,k,v).astype(jnp.float32).sum(), argnums=(0,1,2)))
+        out = g(qt,qt,qt); _ = np.asarray(out[0][0,0,0,0])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = g(qt,qt,qt)
+        _ = np.asarray(out[0][0,0,0,0])
+        dt = (time.perf_counter() - t0 - 0.071)/iters
+        print(f"{label}: {dt*1e3:.2f} ms  ({0.12/dt:.0f} TFLOP/s)")
+    except Exception as e:
+        print(f"{label}: FAIL {type(e).__name__} {str(e)[:100]}")
+
+def mk(bq, bk):
+    return BlockSizes(block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
+                      block_q_major_dkv=bq, block_k_major_dkv=bk, block_k_dkv=bk, block_q_dkv=bq,
+                      block_k_major_dq=bk, block_k_dq=bk, block_q_dq=bq)
+
+timeit(mk(512, 512), "q512 k512 (current)")
+timeit(mk(1024, 512), "q1024 k512")
+timeit(mk(1024, 1024), "q1024 k1024")
+timeit(mk(512, 1024), "q512 k1024")

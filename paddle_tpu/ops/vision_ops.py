@@ -1,0 +1,324 @@
+"""Vision op batch: interpolation kernels, grid_sample, affine_grid,
+pad3d, pool2d/pool3d (+ index variants, unpool), temporal_shift,
+shuffle_channel.
+
+Reference schemas: paddle/phi/ops/yaml/ops.yaml (bilinear_interp,
+nearest_interp, bicubic_interp, linear_interp, trilinear_interp,
+grid_sample, affine_grid, pad3d, pool2d, pool3d,
+max_pool2d_with_index, unpool, temporal_shift, shuffle_channel).
+All NCHW/NCDHW layouts like the reference defaults; resize goes through
+jax.image (XLA gather/matmul lowering, MXU-friendly for the linear
+kernels).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.dispatch import run_op
+from paddle_tpu.core.tensor import Tensor
+
+
+def _t(x):
+    import paddle_tpu as paddle
+    return x if isinstance(x, Tensor) else paddle.to_tensor(x)
+
+
+# ---------------------------------------------------------------------
+# interpolation (phi *_interp kernels). The shared python front
+# (F.interpolate) already dispatches by mode; these are the per-kernel
+# entries for _C_ops parity.
+# ---------------------------------------------------------------------
+def _interp(x, size, method, ndim_spatial):
+    from paddle_tpu.nn.functional.common import interpolate
+    mode = method
+    return interpolate(_t(x), size=list(size), mode=mode)
+
+
+def bilinear_interp(x, out_h, out_w, align_corners=False, **kw):
+    return _interp(x, (out_h, out_w), "bilinear", 2)
+
+
+def nearest_interp(x, out_h, out_w, align_corners=False, **kw):
+    return _interp(x, (out_h, out_w), "nearest", 2)
+
+
+def bicubic_interp(x, out_h, out_w, align_corners=False, **kw):
+    return _interp(x, (out_h, out_w), "bicubic", 2)
+
+
+def linear_interp(x, out_w, align_corners=False, **kw):
+    return _interp(x, (out_w,), "linear", 1)
+
+
+def trilinear_interp(x, out_d, out_h, out_w, align_corners=False, **kw):
+    return _interp(x, (out_d, out_h, out_w), "trilinear", 3)
+
+
+# ---------------------------------------------------------------------
+# grid_sample / affine_grid (phi grid_sample_kernel, affine_grid_kernel)
+# ---------------------------------------------------------------------
+def affine_grid(theta, out_shape, align_corners=True):
+    """theta: [N, 2, 3]; out_shape: [N, C, H, W] -> grid [N, H, W, 2]."""
+    def f(th):
+        n, c, h, w = [int(s) for s in out_shape]
+
+        def base(size):
+            if align_corners:
+                return jnp.linspace(-1.0, 1.0, size, dtype=th.dtype)
+            step = 2.0 / size
+            return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size,
+                                dtype=th.dtype)
+        ys = base(h)
+        xs = base(w)
+        gx, gy = jnp.meshgrid(xs, ys)             # [H, W]
+        ones = jnp.ones_like(gx)
+        coords = jnp.stack([gx, gy, ones], -1)    # [H, W, 3]
+        return jnp.einsum("hwk,njk->nhwj", coords, th)
+    return run_op("affine_grid", f, _t(theta))
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """x: [N, C, H, W]; grid: [N, Ho, Wo, 2] in [-1, 1] (x then y)."""
+    def f(a, g):
+        n, c, h, w = a.shape
+
+        def unnormalize(coord, size):
+            if align_corners:
+                return (coord + 1.0) * (size - 1) / 2.0
+            return ((coord + 1.0) * size - 1.0) / 2.0
+        ix = unnormalize(g[..., 0], w)            # [N, Ho, Wo]
+        iy = unnormalize(g[..., 1], h)
+
+        def pad_coord(coord, size):
+            if padding_mode == "border":
+                return jnp.clip(coord, 0, size - 1)
+            if padding_mode == "reflection":
+                if align_corners:
+                    span = 2 * max(size - 1, 1)
+                    coord = jnp.abs(coord) % span
+                    return jnp.where(coord > size - 1, span - coord, coord)
+                # reflect across [-0.5, size-0.5]
+                coord = jnp.abs((coord + 0.5) % (2 * size) - size) - 0.5
+                return jnp.clip(coord, 0, size - 1)
+            return coord  # zeros: handled by validity mask
+
+        if mode == "nearest":
+            rx = jnp.round(ix)
+            ry = jnp.round(iy)
+            valid = (rx >= 0) & (rx <= w - 1) & (ry >= 0) & (ry <= h - 1)
+            rx = jnp.clip(pad_coord(rx, w), 0, w - 1).astype(jnp.int32)
+            ry = jnp.clip(pad_coord(ry, h), 0, h - 1).astype(jnp.int32)
+            out = a[jnp.arange(n)[:, None, None], :, ry, rx]
+            out = jnp.moveaxis(out, -1, 1)
+            if padding_mode == "zeros":
+                out = out * valid[:, None].astype(a.dtype)
+            return out
+
+        x0 = jnp.floor(ix)
+        y0 = jnp.floor(iy)
+        x1 = x0 + 1
+        y1 = y0 + 1
+        wx1 = ix - x0
+        wy1 = iy - y0
+        wx0 = 1.0 - wx1
+        wy0 = 1.0 - wy1
+
+        def gather(cx, cy):
+            valid = (cx >= 0) & (cx <= w - 1) & (cy >= 0) & (cy <= h - 1)
+            gx = jnp.clip(pad_coord(cx, w), 0, w - 1).astype(jnp.int32)
+            gy = jnp.clip(pad_coord(cy, h), 0, h - 1).astype(jnp.int32)
+            v = a[jnp.arange(n)[:, None, None], :, gy, gx]  # [N,Ho,Wo,C]
+            if padding_mode == "zeros":
+                v = v * valid[..., None].astype(a.dtype)
+            return v
+        out = gather(x0, y0) * (wx0 * wy0)[..., None] \
+            + gather(x1, y0) * (wx1 * wy0)[..., None] \
+            + gather(x0, y1) * (wx0 * wy1)[..., None] \
+            + gather(x1, y1) * (wx1 * wy1)[..., None]
+        return jnp.moveaxis(out, -1, 1)
+    return run_op("grid_sample", f, _t(x), _t(grid))
+
+
+# ---------------------------------------------------------------------
+# pad3d (phi pad3d_kernel): paddings [l, r, t, b, front, back], NCDHW
+# ---------------------------------------------------------------------
+def pad3d(x, paddings, mode="constant", value=0.0, data_format="NCDHW"):
+    def f(a):
+        pl, pr, pt, pb, pf, pk = [int(p) for p in paddings]
+        if data_format == "NCDHW":
+            cfg = [(0, 0), (0, 0), (pf, pk), (pt, pb), (pl, pr)]
+        else:  # NDHWC
+            cfg = [(0, 0), (pf, pk), (pt, pb), (pl, pr), (0, 0)]
+        if mode == "constant":
+            return jnp.pad(a, cfg, constant_values=value)
+        jmode = {"reflect": "reflect", "replicate": "edge",
+                 "circular": "wrap"}[mode]
+        return jnp.pad(a, cfg, mode=jmode)
+    return run_op("pad3d", f, _t(x))
+
+
+# ---------------------------------------------------------------------
+# pooling (phi pool2d/pool3d kernels + index variant + unpool)
+# ---------------------------------------------------------------------
+def _norm2(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def pool2d(x, kernel_size, strides=None, paddings=(0, 0),
+           pooling_type="max", ceil_mode=False, exclusive=True,
+           adaptive=False, global_pooling=False, data_format="NCHW",
+           **kw):
+    def f(a):
+        if data_format == "NHWC":
+            a = jnp.moveaxis(a, -1, 1)
+        kh, kw_ = _norm2(kernel_size)
+        if global_pooling or adaptive and _norm2(kernel_size) == (1, 1):
+            r = (jnp.max(a, (-2, -1), keepdims=True)
+                 if pooling_type == "max"
+                 else jnp.mean(a, (-2, -1), keepdims=True))
+        else:
+            sh, sw = _norm2(strides if strides is not None
+                            else kernel_size)
+            ph, pw = _norm2(paddings)
+            if pooling_type == "max":
+                init = -jnp.inf
+                op = lax.max
+            else:
+                init = 0.0
+                op = lax.add
+            padded = [(0, 0), (0, 0), (ph, ph), (pw, pw)]
+            r = lax.reduce_window(
+                a, jnp.asarray(init, a.dtype), op,
+                (1, 1, kh, kw_), (1, 1, sh, sw),
+                [(0, 0), (0, 0), (ph, ph), (pw, pw)])
+            if pooling_type == "avg":
+                if exclusive and (ph or pw):
+                    ones = jnp.ones(a.shape[-2:], a.dtype)[None, None]
+                    cnt = lax.reduce_window(
+                        jnp.broadcast_to(ones, (1, 1) + a.shape[-2:]),
+                        jnp.asarray(0.0, a.dtype), lax.add,
+                        (1, 1, kh, kw_), (1, 1, sh, sw),
+                        padded)
+                    r = r / cnt
+                else:
+                    r = r / (kh * kw_)
+        if data_format == "NHWC":
+            r = jnp.moveaxis(r, 1, -1)
+        return r
+    return run_op("pool2d", f, _t(x))
+
+
+def pool3d(x, kernel_size, strides=None, paddings=(0, 0, 0),
+           pooling_type="max", ceil_mode=False, exclusive=True,
+           adaptive=False, global_pooling=False, data_format="NCDHW",
+           **kw):
+    def f(a):
+        ks = (kernel_size,) * 3 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        st = ks if strides is None else (
+            (strides,) * 3 if isinstance(strides, int) else tuple(strides))
+        pd = (paddings,) * 3 if isinstance(paddings, int) \
+            else tuple(paddings)
+        if global_pooling:
+            return (jnp.max(a, (-3, -2, -1), keepdims=True)
+                    if pooling_type == "max"
+                    else jnp.mean(a, (-3, -2, -1), keepdims=True))
+        if pooling_type == "max":
+            init, op = -jnp.inf, lax.max
+        else:
+            init, op = 0.0, lax.add
+        pads = [(0, 0), (0, 0)] + [(p, p) for p in pd]
+        r = lax.reduce_window(a, jnp.asarray(init, a.dtype), op,
+                              (1, 1) + ks, (1, 1) + st, pads)
+        if pooling_type == "avg":
+            r = r / float(np.prod(ks))
+        return r
+    return run_op("pool3d", f, _t(x))
+
+
+def max_pool2d_with_index(x, kernel_size, strides=None, paddings=(0, 0),
+                          global_pooling=False, adaptive=False,
+                          ceil_mode=False):
+    """Returns (pooled, flat indices into each H*W map) like the
+    reference max_pool2d_with_index kernel (indices drive unpool)."""
+    def f(a):
+        n, c, h, w = a.shape
+        kh, kw_ = _norm2(kernel_size)
+        sh, sw = _norm2(strides if strides is not None else kernel_size)
+        ph, pw = _norm2(paddings)
+        # patches: [N, C*kh*kw, Ho, Wo]
+        patches = lax.conv_general_dilated_patches(
+            a, (kh, kw_), (sh, sw), [(ph, ph), (pw, pw)])
+        ho, wo = patches.shape[-2:]
+        patches = patches.reshape(n, c, kh * kw_, ho, wo)
+        arg = jnp.argmax(patches, 2)              # [N, C, Ho, Wo]
+        val = jnp.max(patches, 2)
+        # flat index into the (unpadded) input map
+        oy = jnp.arange(ho)[:, None] * sh - ph
+        ox = jnp.arange(wo)[None, :] * sw - pw
+        ky = arg // kw_
+        kx = arg % kw_
+        iy = jnp.clip(oy[None, None] + ky, 0, h - 1)
+        ix = jnp.clip(ox[None, None] + kx, 0, w - 1)
+        return val, (iy * w + ix).astype(jnp.int64)
+    return run_op("max_pool2d_with_index", f, _t(x))
+
+
+def unpool(x, indices, kernel_size=2, strides=None, paddings=0,
+           output_size=None, data_format="NCHW"):
+    """Scatter pooled values back to the positions recorded by
+    max_pool2d_with_index (reference unpool kernel)."""
+    def f(a, idx):
+        n, c, ho, wo = a.shape
+        if output_size is not None:
+            h, w = int(output_size[-2]), int(output_size[-1])
+        else:
+            kh, kw_ = _norm2(kernel_size)
+            sh, sw = _norm2(strides if strides is not None
+                            else kernel_size)
+            h = (ho - 1) * sh + kh
+            w = (wo - 1) * sw + kw_
+        flat = jnp.zeros((n, c, h * w), a.dtype)
+        ii = idx.reshape(n, c, -1)
+        vv = a.reshape(n, c, -1)
+        flat = flat.at[
+            jnp.arange(n)[:, None, None],
+            jnp.arange(c)[None, :, None], ii].add(vv)
+        return flat.reshape(n, c, h, w)
+    return run_op("unpool", f, _t(x), _t(indices))
+
+
+# ---------------------------------------------------------------------
+# temporal_shift / shuffle_channel
+# ---------------------------------------------------------------------
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    """reference temporal_shift kernel (TSM): shift 1/4 channels
+    forward/backward along the segment (time) axis."""
+    def f(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        a = a.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        back = jnp.concatenate(
+            [a[:, 1:, :c1], jnp.zeros_like(a[:, :1, :c1])], 1)
+        fwd = jnp.concatenate(
+            [jnp.zeros_like(a[:, :1, c1:c2]), a[:, :-1, c1:c2]], 1)
+        keep = a[:, :, c2:]
+        out = jnp.concatenate([back, fwd, keep], 2)
+        return out.reshape(nt, c, h, w)
+    return run_op("temporal_shift", f, _t(x))
+
+
+def shuffle_channel(x, group):
+    def f(a):
+        n, c, h, w = a.shape
+        return a.reshape(n, group, c // group, h, w) \
+                .swapaxes(1, 2).reshape(n, c, h, w)
+    return run_op("shuffle_channel", f, _t(x))
