@@ -15,8 +15,8 @@ from paddle_tpu.core.dtype import (  # noqa: F401
     set_default_dtype, get_default_dtype, finfo, iinfo, promote_types,
 )
 from paddle_tpu.core.place import (  # noqa: F401
-    CPUPlace, CUDAPlace, CustomPlace, Place, TPUPlace, get_device,
-    set_device, is_compiled_with_tpu,
+    CPUPlace, CUDAPlace, CUDAPinnedPlace, CustomPlace, IPUPlace, Place,
+    TPUPlace, XPUPlace, get_device, set_device, is_compiled_with_tpu,
 )
 from paddle_tpu.core.generator import seed, default_generator  # noqa: F401
 from paddle_tpu.core.flags import (  # noqa: F401
@@ -36,7 +36,23 @@ from paddle_tpu.ops.linalg import (  # noqa: F401
 )
 from paddle_tpu.ops.random import (  # noqa: F401
     rand, randn, randint, randint_like, randperm, uniform, normal,
-    standard_normal, bernoulli, multinomial, poisson, rand_like, randn_like,
+    standard_normal, bernoulli, bernoulli_, binomial, multinomial, poisson,
+    rand_like, randn_like, normal_, uniform_, exponential_,
+)
+from paddle_tpu.ops.extra import (  # noqa: F401
+    renorm, reverse, shape, as_strided, reduce_as, gammaln, polygamma,
+    gammainc, gammaincc, standard_gamma,
+)
+from paddle_tpu.ops.compat import (  # noqa: F401
+    block_diag, cartesian_prod, combinations, vander, column_stack,
+    row_stack, hsplit, vsplit, dsplit, unflatten, add_n, slice_scatter,
+    select_scatter, diagonal_scatter, isin, histogram_bin_edges, pdist,
+    sinc, sgn, signbit, frexp, ldexp, trapezoid, cumulative_trapezoid,
+    multigammaln, log_normal, rank, tolist, is_complex, is_integer,
+    is_floating_point, check_shape, disable_signal_handler,
+    set_printoptions, get_rng_state, set_rng_state, get_cuda_rng_state,
+    set_cuda_rng_state, create_parameter, batch, LazyGuard, flops,
+    cauchy_, geometric_, log_normal_,
 )
 
 # ---- autograd -------------------------------------------------------------
@@ -63,15 +79,32 @@ import paddle_tpu.linalg as linalg  # noqa: F401
 _LAZY = {"vision", "hapi", "profiler", "static", "models", "parallel",
          "incubate", "distribution", "sparse", "device", "inference",
          "quantization", "utils", "text", "geometric"}
+import paddle_tpu.fft as fft  # noqa: F401
+import paddle_tpu.signal as signal  # noqa: F401
+
+# paddle.dtype is the dtype class itself (DataType in the reference);
+# our dtypes are np.dtype instances (core/dtype.py).
+import numpy as _np
+dtype = _np.dtype
+
+# generated `<op>_` inplace variants over every out-of-place op above
+from paddle_tpu.ops.compat import _build_inplace_variants as _biv
+globals().update(_biv(globals()))
+del _biv
 
 
 def __getattr__(name):
     if name in _LAZY:
         import importlib
         return importlib.import_module(f"paddle_tpu.{name}")
+    if name == "Model":
+        from paddle_tpu.hapi import Model
+        return Model
+    if name == "DataParallel":
+        from paddle_tpu.distributed.parallel import DataParallel
+        return DataParallel
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
-import paddle_tpu.fft as fft  # noqa: F401
-import paddle_tpu.signal as signal  # noqa: F401
+
 
 __version__ = "0.1.0"
 

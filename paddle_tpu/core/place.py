@@ -59,6 +59,23 @@ class CUDAPlace(Place):  # accepted for API compatibility; maps to 'gpu'
     device_type = "gpu"
 
 
+class CUDAPinnedPlace(Place):
+    """Pinned-host-memory place (phi/common/place.h GPUPINNED). On TPU the
+    equivalent is host memory staged for device transfer; we map it to cpu."""
+    device_type = "cpu"
+
+    def __repr__(self):
+        return "Place(gpu_pinned)"
+
+
+class XPUPlace(Place):  # accepted for API compatibility; maps to 'tpu'
+    device_type = "tpu"
+
+
+class IPUPlace(Place):
+    device_type = "cpu"
+
+
 class CustomPlace(Place):
     def __init__(self, device_type: str, device_id: int = 0):
         super().__init__(device_id)

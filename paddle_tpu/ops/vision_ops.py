@@ -33,30 +33,30 @@ def _t(x):
 # (F.interpolate) already dispatches by mode; these are the per-kernel
 # entries for _C_ops parity.
 # ---------------------------------------------------------------------
-def _interp(x, size, method, ndim_spatial):
+def _interp(x, size, method, align_corners=False):
     from paddle_tpu.nn.functional.common import interpolate
-    mode = method
-    return interpolate(_t(x), size=list(size), mode=mode)
+    return interpolate(_t(x), size=list(size), mode=method,
+                       align_corners=align_corners)
 
 
 def bilinear_interp(x, out_h, out_w, align_corners=False, **kw):
-    return _interp(x, (out_h, out_w), "bilinear", 2)
+    return _interp(x, (out_h, out_w), "bilinear", align_corners)
 
 
 def nearest_interp(x, out_h, out_w, align_corners=False, **kw):
-    return _interp(x, (out_h, out_w), "nearest", 2)
+    return _interp(x, (out_h, out_w), "nearest", align_corners)
 
 
 def bicubic_interp(x, out_h, out_w, align_corners=False, **kw):
-    return _interp(x, (out_h, out_w), "bicubic", 2)
+    return _interp(x, (out_h, out_w), "bicubic", align_corners)
 
 
 def linear_interp(x, out_w, align_corners=False, **kw):
-    return _interp(x, (out_w,), "linear", 1)
+    return _interp(x, (out_w,), "linear", align_corners)
 
 
 def trilinear_interp(x, out_d, out_h, out_w, align_corners=False, **kw):
-    return _interp(x, (out_d, out_h, out_w), "trilinear", 3)
+    return _interp(x, (out_d, out_h, out_w), "trilinear", align_corners)
 
 
 # ---------------------------------------------------------------------
@@ -170,6 +170,22 @@ def _norm2(v):
     return (v, v) if isinstance(v, int) else tuple(v)
 
 
+def _adaptive_pool_axis(a, axis, out, pooling_type):
+    """Pool axis into `out` adaptive bins (paddle AdaptiveKernel boundary
+    rule: start=floor(i*L/out), end=ceil((i+1)*L/out)); static unrolled
+    slices so XLA sees fixed shapes."""
+    L = a.shape[axis]
+    red = jnp.max if pooling_type == "max" else jnp.mean
+    pieces = []
+    for i in range(int(out)):
+        s = (i * L) // out
+        e = -(-((i + 1) * L) // out)  # ceil
+        sl = [slice(None)] * a.ndim
+        sl[axis] = slice(s, e)
+        pieces.append(red(a[tuple(sl)], axis=axis, keepdims=True))
+    return jnp.concatenate(pieces, axis=axis)
+
+
 def pool2d(x, kernel_size, strides=None, paddings=(0, 0),
            pooling_type="max", ceil_mode=False, exclusive=True,
            adaptive=False, global_pooling=False, data_format="NCHW",
@@ -178,10 +194,17 @@ def pool2d(x, kernel_size, strides=None, paddings=(0, 0),
         if data_format == "NHWC":
             a = jnp.moveaxis(a, -1, 1)
         kh, kw_ = _norm2(kernel_size)
-        if global_pooling or adaptive and _norm2(kernel_size) == (1, 1):
+        if global_pooling or (adaptive and (kh, kw_) == (1, 1)):
             r = (jnp.max(a, (-2, -1), keepdims=True)
                  if pooling_type == "max"
                  else jnp.mean(a, (-2, -1), keepdims=True))
+        elif adaptive:
+            # adaptive: kernel_size is the OUTPUT size; cell [i,j] covers
+            # rows [floor(i*H/oh), ceil((i+1)*H/oh)) etc. The rectangular
+            # cells are a cross product, so pooling is separable: pool the
+            # row bins, then the column bins.
+            r = _adaptive_pool_axis(a, -2, kh, pooling_type)
+            r = _adaptive_pool_axis(r, -1, kw_, pooling_type)
         else:
             sh, sw = _norm2(strides if strides is not None
                             else kernel_size)
@@ -252,9 +275,20 @@ def max_pool2d_with_index(x, kernel_size, strides=None, paddings=(0, 0),
         kh, kw_ = _norm2(kernel_size)
         sh, sw = _norm2(strides if strides is not None else kernel_size)
         ph, pw = _norm2(paddings)
+        # pad with the dtype min ourselves: conv_general_dilated_patches
+        # pads with 0, which would beat negative inputs in the max.
+        # (finfo.min, not -inf: the patch extraction is a one-hot conv and
+        # 0 * -inf = nan; HIGHEST precision so the one-hot dot is exact —
+        # the default matmul precision truncates values to bf16)
+        if ph or pw:
+            a = jnp.pad(a, [(0, 0), (0, 0), (ph, ph), (pw, pw)],
+                        constant_values=jnp.finfo(a.dtype).min if
+                        jnp.issubdtype(a.dtype, jnp.floating)
+                        else jnp.iinfo(a.dtype).min)
         # patches: [N, C*kh*kw, Ho, Wo]
         patches = lax.conv_general_dilated_patches(
-            a, (kh, kw_), (sh, sw), [(ph, ph), (pw, pw)])
+            a, (kh, kw_), (sh, sw), [(0, 0), (0, 0)],
+            precision=lax.Precision.HIGHEST)
         ho, wo = patches.shape[-2:]
         patches = patches.reshape(n, c, kh * kw_, ho, wo)
         arg = jnp.argmax(patches, 2)              # [N, C, Ho, Wo]
@@ -301,6 +335,8 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
     """reference temporal_shift kernel (TSM): shift 1/4 channels
     forward/backward along the segment (time) axis."""
     def f(a):
+        if data_format == "NHWC":
+            a = jnp.moveaxis(a, -1, 1)
         nt, c, h, w = a.shape
         n = nt // seg_num
         a = a.reshape(n, seg_num, c, h, w)
@@ -311,8 +347,10 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
         fwd = jnp.concatenate(
             [jnp.zeros_like(a[:, :1, c1:c2]), a[:, :-1, c1:c2]], 1)
         keep = a[:, :, c2:]
-        out = jnp.concatenate([back, fwd, keep], 2)
-        return out.reshape(nt, c, h, w)
+        out = jnp.concatenate([back, fwd, keep], 2).reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
     return run_op("temporal_shift", f, _t(x))
 
 
