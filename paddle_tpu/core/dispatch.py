@@ -138,12 +138,10 @@ def run_op(name: str, fn: Callable, *inputs, n_outputs=None, amp=True,
     return out_tensors[0] if single else tuple(out_tensors)
 
 
-def run_op_inplace(name: str, fn: Callable, target: Tensor, *extra_inputs,
-                   **kw):
-    """Inplace op: computes fn(target, *extra) then rebinds target's buffer
-    (ops.yaml `inplace:` semantics on immutable XLA buffers)."""
-    out = run_op(name, fn, target, *extra_inputs, **kw)
-    res = out[0] if isinstance(out, tuple) else out
+def rebind_inplace(target: Tensor, res: Tensor) -> Tensor:
+    """Rebind `res`'s buffer + autograd node onto `target` (the tail of
+    every inplace op: ops.yaml `inplace:` semantics on immutable XLA
+    buffers). Shared by run_op_inplace and the generated `<op>_` family."""
     target._assign_array(res._data)
     # the result of an inplace op participates in autograd via the new node
     target._grad_node = res._grad_node
@@ -153,3 +151,12 @@ def run_op_inplace(name: str, fn: Callable, target: Tensor, *extra_inputs,
         import weakref
         res._grad_node.out_refs[res._out_idx] = weakref.ref(target)
     return target
+
+
+def run_op_inplace(name: str, fn: Callable, target: Tensor, *extra_inputs,
+                   **kw):
+    """Inplace op: computes fn(target, *extra) then rebinds target's buffer
+    (ops.yaml `inplace:` semantics on immutable XLA buffers)."""
+    out = run_op(name, fn, target, *extra_inputs, **kw)
+    res = out[0] if isinstance(out, tuple) else out
+    return rebind_inplace(target, res)

@@ -63,7 +63,8 @@ def cartesian_prod(x, name=None):
         grids = jnp.meshgrid(*vs, indexing="ij")
         return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
     if len(xs) == 1:
-        return run_op("cartesian_prod", lambda v: v.reshape(-1, 1), xs[0])
+        # single input: reference returns the flat 1-D tensor
+        return run_op("cartesian_prod", lambda v: v.reshape(-1), xs[0])
     return run_op("cartesian_prod", f, *xs)
 
 
@@ -148,7 +149,7 @@ def unflatten(x, axis, shape, name=None):
 
 def add_n(inputs, name=None):
     if isinstance(inputs, Tensor):
-        return inputs
+        inputs = [inputs]
     xs = [_t(v) for v in inputs]
     return run_op("add_n", lambda *vs: sum(vs[1:], vs[0]), *xs)
 
@@ -270,9 +271,9 @@ def ldexp(x, y, name=None):
     x, y = _t(x), _t(y)
 
     def f(a, b):
-        out = a.astype(jnp.float32) * (2.0 ** b.astype(jnp.float32))
-        return out if a.dtype in (jnp.float32, jnp.float64) \
-            else out.astype(jnp.float32)
+        dt = a.dtype if jnp.issubdtype(a.dtype, jnp.floating) \
+            else jnp.float32
+        return a.astype(dt) * (jnp.asarray(2.0, dt) ** b.astype(dt))
     return run_op("ldexp", f, x, y)
 
 
@@ -337,11 +338,6 @@ def log_normal(mean=1.0, std=2.0, shape=None, name=None):
     key = default_generator().next_key()
     z = jax.random.normal(key, shape, jnp.float32)
     return Tensor._wrap(jnp.exp(z * std + mean))
-
-
-def reverse(x, axis, name=None):
-    from paddle_tpu.ops.extra import reverse as _rev
-    return _rev(x, axis)
 
 
 # ---------------------------------------------------------------------------
@@ -473,27 +469,37 @@ class LazyGuard:
 
 
 def flops(net, input_size, custom_ops=None, print_detail=False):
-    """Estimate FLOPs of a Layer by tracing one forward with shape
-    accounting (ref: python/paddle/hapi/dynamic_flops.py). Counts matmul-like
-    layers analytically."""
+    """Estimate FLOPs of a Layer via forward hooks that count matmul-like
+    work per layer (ref: python/paddle/hapi/dynamic_flops.py)."""
     from paddle_tpu import nn
+    import paddle_tpu as paddle
     total = [0]
 
-    def count(layer, x_shape):
+    def hook(layer, inputs, output):
         if isinstance(layer, nn.Linear):
-            total[0] += 2 * int(np.prod(x_shape[:-1])) \
-                * layer.weight.shape[0] * layer.weight.shape[1]
-        elif isinstance(layer, nn.Conv2D):
-            pass  # counted via output below
-    # simple estimate: run forward and count parameters*2 per sample
-    import paddle_tpu as paddle
-    x = paddle.zeros(input_size)
+            # [*, in] @ [in, out]: 2*prod(batch)*in*out
+            x = inputs[0]
+            total[0] += 2 * int(np.prod(x.shape[:-1])) \
+                * int(np.prod(layer.weight.shape))
+        elif output is not None and hasattr(layer, "weight") \
+                and layer.weight is not None and hasattr(output, "shape"):
+            # conv-like: 2 * output positions * weight size
+            w = int(np.prod(layer.weight.shape))
+            total[0] += 2 * int(np.prod(output.shape[:2])) * w
+
+    handles = []
+    for layer in net.sublayers(include_self=True):
+        if not layer.sublayers():  # leaves only
+            handles.append(layer.register_forward_post_hook(hook))
     try:
-        net(x)
-    except Exception:
-        pass
-    n_params = sum(int(p.size) for _, p in net.named_parameters())
-    total[0] = max(total[0], 2 * n_params * int(np.prod(input_size[:1])))
+        net(paddle.zeros(input_size))
+    finally:
+        for h in handles:
+            h.remove()
+    if total[0] == 0:
+        # fallback when the net has no hookable leaves
+        n_params = sum(int(p.size) for _, p in net.named_parameters())
+        total[0] = 2 * n_params * int(np.prod(input_size[:1]))
     return total[0]
 
 
@@ -505,16 +511,12 @@ def _inplacify(fn, name):
     """Wrap an out-of-place op as `<op>_` (ops.yaml inplace semantics): the
     result buffer is rebound onto x with a version bump; autograd follows the
     new node exactly like run_op_inplace."""
+    from paddle_tpu.core.dispatch import rebind_inplace
+
     def op(x, *args, **kw):
         res = fn(x, *args, **kw)
         res = res[0] if isinstance(res, tuple) else res
-        x._assign_array(res._data)
-        x._grad_node = res._grad_node
-        x._out_idx = res._out_idx
-        x.stop_gradient = res.stop_gradient and x.stop_gradient
-        if res._grad_node is not None:
-            res._grad_node.out_refs[res._out_idx] = weakref.ref(x)
-        return x
+        return rebind_inplace(x, res)
     op.__name__ = name
     op.__qualname__ = name
     op.__doc__ = f"Inplace variant of `{fn.__name__}`."

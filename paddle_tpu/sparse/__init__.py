@@ -1,0 +1,347 @@
+"""paddle.sparse equivalent over jax.experimental.sparse BCOO
+(reference: phi sparse_coo/csr tensors + paddle.sparse API)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+class SparseCooTensor(Tensor):
+    """Tensor whose storage is a BCOO sparse array.
+
+    Dense materialization is LAZY: `_data` densifies only when a dense op
+    actually touches it (the reference keeps COO storage until a dense
+    kernel is selected; densifying eagerly would OOM on large sparse
+    tensors).
+    """
+
+    @classmethod
+    def _wrap_bcoo(cls, bcoo, stop_gradient=True):
+        t = cls.__new__(cls)
+        t._init_from_array(None, stop_gradient)
+        t._bcoo = bcoo
+        return t
+
+    @property
+    def _data(self):
+        d = Tensor._data.__get__(self)
+        if d is None:
+            d = self._bcoo.todense()
+            Tensor._data.__set__(self, d)
+        return d
+
+    @_data.setter
+    def _data(self, value):
+        Tensor._data.__set__(self, value)
+
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def ndim(self):
+        return self._bcoo.ndim
+
+    @property
+    def dtype(self):
+        return np.dtype(self._bcoo.data.dtype)
+
+    def indices(self):
+        return Tensor._wrap(self._bcoo.indices.T)
+
+    def values(self):
+        return Tensor._wrap(self._bcoo.data)
+
+    def to_dense(self):
+        return Tensor._wrap(self._bcoo.todense(), self.stop_gradient)
+
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    @property
+    def is_sparse_coo(self):
+        return True
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    idx = indices._data if isinstance(indices, Tensor) else \
+        jnp.asarray(np.asarray(indices))
+    val = values._data if isinstance(values, Tensor) else \
+        jnp.asarray(np.asarray(values))
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in np.asarray(idx).max(axis=1))
+    bcoo = jsparse.BCOO((val, idx.T.astype(jnp.int32)),
+                        shape=tuple(int(s) for s in shape))
+    return SparseCooTensor._wrap_bcoo(bcoo, stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    crows_np = np.asarray(crows.numpy() if isinstance(crows, Tensor)
+                          else crows)
+    cols_np = np.asarray(cols.numpy() if isinstance(cols, Tensor)
+                         else cols)
+    rows = np.repeat(np.arange(len(crows_np) - 1),
+                     np.diff(crows_np))
+    idx = np.stack([rows, cols_np])
+    return sparse_coo_tensor(idx, values, shape, dtype, place,
+                             stop_gradient)
+
+
+def to_sparse_coo(x: Tensor, sparse_dim=None):
+    """Densify → COO. sparse_dim keeps the trailing ndim-sparse_dim dims
+    dense (the reference's NDHWC sparse layout stores channels dense)."""
+    n_dense = 0 if sparse_dim is None else x.ndim - int(sparse_dim)
+    bcoo = jsparse.BCOO.fromdense(x._data, n_dense=n_dense)
+    return SparseCooTensor._wrap_bcoo(bcoo, x.stop_gradient)
+
+
+def to_dense(x):
+    if isinstance(x, SparseCooTensor):
+        return x.to_dense()
+    return x
+
+
+def matmul(x, y, name=None):
+    if isinstance(x, SparseCooTensor):
+        out = jsparse.bcoo_dot_general(
+            x._bcoo, y._data if isinstance(y, Tensor) else jnp.asarray(y),
+            dimension_numbers=(((x._bcoo.ndim - 1,), (0,)), ((), ())))
+        return Tensor._wrap(out)
+    return paddle.matmul(x, y)
+
+
+def add(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        return SparseCooTensor._wrap_bcoo(
+            jsparse.bcoo_add(x._bcoo, y._bcoo)
+            if hasattr(jsparse, "bcoo_add")
+            else jsparse.BCOO.fromdense(x._bcoo.todense()
+                                        + y._bcoo.todense()))
+    return paddle.add(to_dense(x), to_dense(y))
+
+
+def mask_as(x: Tensor, mask: SparseCooTensor):
+    idx = mask._bcoo.indices
+    vals = x._data[tuple(idx.T)]
+    return SparseCooTensor._wrap_bcoo(
+        jsparse.BCOO((vals, idx), shape=x._data.shape))
+
+
+# ---------------------------------------------------------------------------
+# elementwise ops on the value array (all zero-preserving, so operating on
+# the stored values alone is exact — reference: paddle/sparse/unary.py over
+# phi sparse unary kernels)
+# ---------------------------------------------------------------------------
+
+def _on_values(name, f):
+    def op(x, name=None):
+        if isinstance(x, SparseCooTensor):
+            b = x._bcoo
+            return SparseCooTensor._wrap_bcoo(
+                jsparse.BCOO((f(b.data), b.indices), shape=b.shape),
+                x.stop_gradient)
+        return getattr(paddle, name.rstrip("_"), None)(x) \
+            if hasattr(paddle, name) else Tensor._wrap(f(x._data))
+    op.__name__ = name
+    return op
+
+
+sin = _on_values("sin", jnp.sin)
+tan = _on_values("tan", jnp.tan)
+asin = _on_values("asin", jnp.arcsin)
+atan = _on_values("atan", jnp.arctan)
+sinh = _on_values("sinh", jnp.sinh)
+tanh = _on_values("tanh", jnp.tanh)
+asinh = _on_values("asinh", jnp.arcsinh)
+atanh = _on_values("atanh", jnp.arctanh)
+sqrt = _on_values("sqrt", jnp.sqrt)
+square = _on_values("square", jnp.square)
+log1p = _on_values("log1p", jnp.log1p)
+abs = _on_values("abs", jnp.abs)
+expm1 = _on_values("expm1", jnp.expm1)
+neg = _on_values("neg", jnp.negative)
+deg2rad = _on_values("deg2rad", jnp.deg2rad)
+rad2deg = _on_values("rad2deg", jnp.rad2deg)
+relu = _on_values("relu", jax.nn.relu)
+relu6 = _on_values("relu6", lambda v: jnp.clip(v, 0, 6))
+isnan = _on_values("isnan", jnp.isnan)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _on_values("leaky_relu",
+                      lambda v: jnp.where(v >= 0, v, negative_slope * v))(x)
+
+
+def pow(x, factor, name=None):
+    return _on_values("pow", lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from paddle_tpu.core import dtype as dtype_mod
+    b = x._bcoo
+    data = b.data if value_dtype is None else \
+        b.data.astype(dtype_mod.convert_dtype(value_dtype))
+    idx = b.indices if index_dtype is None else \
+        b.indices.astype(dtype_mod.convert_dtype(index_dtype))
+    return SparseCooTensor._wrap_bcoo(
+        jsparse.BCOO((data, idx), shape=b.shape), x.stop_gradient)
+
+
+# ---------------------------------------------------------------------------
+# binary / matrix ops (reference: paddle/sparse/binary.py, multiary.py)
+# ---------------------------------------------------------------------------
+
+def _coalesced(b):
+    return b.sum_duplicates(nse=b.nse)
+
+
+def _ewise(name, f):
+    """Elementwise sparse(+)sparse: same-index fast path on values, general
+    path densify-merge-resparsify (reference requires same shape)."""
+    def op(x, y, name=None):
+        if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+            bx, by = x._bcoo, y._bcoo
+            if bx.indices.shape == by.indices.shape and \
+                    bool(jnp.all(bx.indices == by.indices)):
+                return SparseCooTensor._wrap_bcoo(
+                    jsparse.BCOO((f(bx.data, by.data), bx.indices),
+                                 shape=bx.shape))
+            dense = f(bx.todense(), by.todense())
+            return SparseCooTensor._wrap_bcoo(jsparse.BCOO.fromdense(dense))
+        return Tensor._wrap(f(to_dense(x)._data, to_dense(y)._data))
+    op.__name__ = name
+    return op
+
+
+subtract = _ewise("subtract", jnp.subtract)
+multiply = _ewise("multiply", jnp.multiply)
+divide = _ewise("divide", jnp.true_divide)
+
+
+def mv(x, vec, name=None):
+    """Sparse matrix × dense vector (reference sparse mv kernel) — on TPU a
+    BCOO dot_general, which XLA lowers to gather+segment-sum."""
+    v = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    out = jsparse.bcoo_dot_general(
+        x._bcoo, v, dimension_numbers=(((1,), (0,)), ((), ())))
+    return Tensor._wrap(out)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """(x @ y) evaluated only at mask's nonzero coordinates (reference
+    sparse masked_matmul — SDDMM). Gathers the needed rows/cols so only
+    nse dot products are computed."""
+    xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    ya = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+    idx = mask._bcoo.indices
+    rows = xa[idx[:, 0]]            # [nse, K]
+    cols = ya[:, idx[:, 1]].T       # [nse, K]
+    vals = jnp.sum(rows * cols, axis=-1)
+    return SparseCooTensor._wrap_bcoo(
+        jsparse.BCOO((vals, idx), shape=(xa.shape[0], ya.shape[1])))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x@y) with sparse x (reference sparse addmm)."""
+    prod = matmul(x, y)
+    return Tensor._wrap(beta * to_dense(input)._data + alpha * prod._data)
+
+
+def transpose(x, perm, name=None):
+    b = _coalesced(x._bcoo)
+    out = jsparse.bcoo_transpose(b, permutation=tuple(int(p) for p in perm))
+    return SparseCooTensor._wrap_bcoo(out, x.stop_gradient)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    b = x._bcoo
+    if axis is None:
+        out = jnp.sum(b.data)
+        if keepdim:
+            out = out.reshape((1,) * b.ndim)
+            return SparseCooTensor._wrap_bcoo(jsparse.BCOO.fromdense(out))
+        return Tensor._wrap(out)
+    ax = axis % b.ndim if isinstance(axis, int) else tuple(
+        a % b.ndim for a in axis)
+    axes = (ax,) if isinstance(ax, int) else ax
+    out = jsparse.bcoo_reduce_sum(b, axes=axes)
+    if keepdim:
+        dense = out.todense()
+        for a in sorted(axes):
+            dense = jnp.expand_dims(dense, a)
+        return SparseCooTensor._wrap_bcoo(jsparse.BCOO.fromdense(dense))
+    return SparseCooTensor._wrap_bcoo(out, x.stop_gradient)
+
+
+def coalesce(x, name=None):
+    return SparseCooTensor._wrap_bcoo(_coalesced(x._bcoo), x.stop_gradient)
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+def reshape(x, shape, name=None):
+    total = int(np.prod(x.shape))
+    shape = [int(s) for s in shape]
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape = [total // known if s == -1 else s for s in shape]
+    out = jsparse.bcoo_reshape(_coalesced(x._bcoo), new_sizes=tuple(shape))
+    return SparseCooTensor._wrap_bcoo(out, x.stop_gradient)
+
+
+def slice(x, axes, starts, ends, name=None):
+    b = _coalesced(x._bcoo)
+    start = [0] * b.ndim
+    sizes = list(b.shape)
+    for a, s, e in zip(axes, starts, ends):
+        a = int(a) % b.ndim
+        s = int(s) + (b.shape[a] if int(s) < 0 else 0)
+        e = int(e) + (b.shape[a] if int(e) < 0 else 0)
+        e = min(e, b.shape[a])
+        start[a], sizes[a] = s, e - s
+    out = jsparse.bcoo_dynamic_slice(b, start_indices=start,
+                                     slice_sizes=sizes)
+    return SparseCooTensor._wrap_bcoo(out, x.stop_gradient)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA (Halko et al.) built on matmuls so it runs with
+    sparse or dense x on the MXU (reference: paddle/sparse/__init__.py
+    pca_lowrank -> phi svd kernels)."""
+    dense = to_dense(x)._data
+    m, n = dense.shape
+    q = min(6, m, n) if q is None else int(q)
+    if center:
+        dense = dense - jnp.mean(dense, axis=0, keepdims=True)
+    key = jax.random.PRNGKey(0)
+    omega = jax.random.normal(key, (n, q), dense.dtype)
+    y = dense @ omega
+    for _ in range(niter):
+        y = dense @ (dense.T @ y)
+    qmat, _ = jnp.linalg.qr(y)
+    b = qmat.T @ dense
+    u_t, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    u = qmat @ u_t
+    return (Tensor._wrap(u), Tensor._wrap(s), Tensor._wrap(vt.T))
+
+
+from paddle_tpu.sparse import nn  # noqa: E402,F401
+
+__all__ = [
+    "SparseCooTensor", "sparse_coo_tensor", "sparse_csr_tensor",
+    "to_sparse_coo", "to_dense", "matmul", "add", "mask_as",
+    "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh", "atanh",
+    "sqrt", "square", "log1p", "abs", "pow", "pca_lowrank", "cast", "neg",
+    "deg2rad", "rad2deg", "expm1", "mv", "masked_matmul", "addmm",
+    "subtract", "transpose", "sum", "multiply", "divide", "coalesce",
+    "is_same_shape", "reshape", "isnan", "slice", "nn",
+]

@@ -1,0 +1,285 @@
+"""paddle.sparse.nn — layers over sparse COO tensors
+(reference: python/paddle/sparse/nn/{layer,functional}/ over phi sparse
+conv/pool/bn kernels).
+
+TPU-native design note: the reference's submanifold sparse conv gathers
+active sites and runs gemm per kernel offset (CUDA scatter/gather). On TPU,
+moderate-sparsity 3-D point-cloud workloads map better onto the MXU as a
+dense conv on the densified block plus an output mask (submanifold rule:
+output active set == input active set). That is what Conv3D/SubmConv3D do
+here: XLA fuses the mask into the conv epilogue; storage stays COO at the
+boundary.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer.layers import Layer
+
+
+def _sp():
+    import paddle_tpu.sparse as sp
+    return sp
+
+
+def _channels_dense(x):
+    """BCOO view with the trailing (channel) dim stored dense — the
+    layout the reference keeps for NDHWC sparse tensors (values carry the
+    channel vector per active site)."""
+    b = x._bcoo
+    if b.n_dense >= 1:
+        return b
+    return jsparse.bcoo_update_layout(b.sum_duplicates(nse=b.nse),
+                                      n_dense=1, on_inefficient=None)
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return _sp().relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return _sp().relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return _sp().leaky_relu(x, self.negative_slope)
+
+
+class Softmax(Layer):
+    """Sparse softmax over the last dense dim: softmax across the stored
+    values of each row (reference sparse softmax kernel semantics for CSR:
+    normalization is over nonzeros only)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        sp = _sp()
+        b = x._bcoo.sum_duplicates(nse=x._bcoo.nse)
+        rows = b.indices[:, 0]
+        n_rows = b.shape[0]
+        vals = b.data
+        row_max = jax.ops.segment_max(vals, rows, n_rows)
+        vals = jnp.exp(vals - row_max[rows])
+        denom = jax.ops.segment_sum(vals, rows, n_rows)
+        out = vals / denom[rows]
+        return sp.SparseCooTensor._wrap_bcoo(
+            jsparse.BCOO((out, b.indices), shape=b.shape))
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the channel (last) axis of a sparse NDHWC tensor:
+    statistics computed over stored values only (matching the reference,
+    which normalizes the nnz values per channel)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 data_format="NDHWC", use_global_stats=None):
+        super().__init__()
+        from paddle_tpu.core.tensor import Parameter
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.weight = Parameter(np.ones(num_features, np.float32))
+        self.bias = Parameter(np.zeros(num_features, np.float32))
+        self._mean = Tensor(np.zeros(num_features, np.float32))
+        self._variance = Tensor(np.ones(num_features, np.float32))
+        self.register_buffer("_mean", self._mean)
+        self.register_buffer("_variance", self._variance)
+
+    def forward(self, x):
+        sp = _sp()
+        b = _channels_dense(x)
+        vals = b.data  # [nse, C]
+        if self.training:
+            mean = jnp.mean(vals, axis=0)
+            var = jnp.var(vals, axis=0)
+            m = self.momentum
+            self._mean._assign_array(m * self._mean._data + (1 - m) * mean)
+            self._variance._assign_array(
+                m * self._variance._data + (1 - m) * var)
+        else:
+            mean, var = self._mean._data, self._variance._data
+        inv = jax.lax.rsqrt(var + self.epsilon)
+        out = (vals - mean) * inv * self.weight._data + self.bias._data
+        return sp.SparseCooTensor._wrap_bcoo(
+            jsparse.BCOO((out.astype(vals.dtype), b.indices), shape=b.shape))
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica BatchNorm: under pjit/shard_map the mean/var reduce
+    is a psum over the dp axis; single-process it equals BatchNorm."""
+
+
+def _conv3d_dense(x, weight, bias, stride, padding, dilation, groups,
+                  subm, data_format="NDHWC"):
+    """Shared dense-compute path for sparse Conv3D/SubmConv3D."""
+    dense = x._bcoo.todense()  # [N, D, H, W, C]
+    lhs = jnp.moveaxis(dense, -1, 1)  # NCDHW
+    w = weight  # [kd, kh, kw, C_in/groups, C_out]
+    rhs = jnp.transpose(w, (4, 3, 0, 1, 2))  # OIDHW
+    st = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+    dl = (dilation,) * 3 if isinstance(dilation, int) else tuple(dilation)
+    if subm:
+        # submanifold: output spatial size == input; SAME-style padding
+        pads = [((k - 1) * d // 2, (k - 1) * d - (k - 1) * d // 2)
+                for k, d in zip(rhs.shape[2:], dl)]
+        st = (1, 1, 1)
+    elif isinstance(padding, int):
+        pads = [(padding, padding)] * 3
+    else:
+        pads = [(int(p), int(p)) if isinstance(p, (int, np.integer))
+                else tuple(p) for p in padding]
+    out = jax.lax.conv_general_dilated(
+        lhs, rhs, window_strides=st, padding=pads, rhs_dilation=dl,
+        feature_group_count=groups)
+    out = jnp.moveaxis(out, 1, -1)  # NDHWC
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+class Conv3D(Layer):
+    """Sparse 3-D conv (reference sparse conv3d). Dense MXU compute; the
+    output is re-sparsified from its natural support."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 key=None):
+        super().__init__()
+        from paddle_tpu.core.tensor import Parameter
+        ks = (kernel_size,) * 3 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        fan_in = in_channels * int(np.prod(ks))
+        bound = 1.0 / np.sqrt(fan_in)
+        rng = np.random.RandomState(0)
+        self.weight = Parameter(rng.uniform(
+            -bound, bound,
+            ks + (in_channels // groups, out_channels)).astype(np.float32))
+        self.bias = None if bias_attr is False else Parameter(
+            rng.uniform(-bound, bound, (out_channels,)).astype(np.float32))
+        self._cfg = (stride, padding, dilation, groups)
+        self._subm = False
+
+    def forward(self, x):
+        sp = _sp()
+        stride, padding, dilation, groups = self._cfg
+        out = _conv3d_dense(x, self.weight._data,
+                            None if self.bias is None else self.bias._data,
+                            stride, padding, dilation, groups, self._subm)
+        if self._subm:
+            # submanifold rule: keep exactly the input's active sites
+            idx = _channels_dense(x).indices  # [nse, 4] over N,D,H,W
+            vals = out[tuple(idx.T)]          # [nse, C_out]
+            bcoo = jsparse.BCOO((vals, idx), shape=out.shape)
+            return sp.SparseCooTensor._wrap_bcoo(bcoo)
+        return sp.to_sparse_coo(Tensor._wrap(out))
+
+
+class SubmConv3D(Conv3D):
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self._subm = True
+
+
+class Conv2D(Layer):
+    """Sparse 2-D conv (NHWC) — same dense-compute design as Conv3D."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NHWC"):
+        super().__init__()
+        from paddle_tpu.core.tensor import Parameter
+        ks = (kernel_size,) * 2 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        fan_in = in_channels * int(np.prod(ks))
+        bound = 1.0 / np.sqrt(fan_in)
+        rng = np.random.RandomState(0)
+        self.weight = Parameter(rng.uniform(
+            -bound, bound,
+            ks + (in_channels // groups, out_channels)).astype(np.float32))
+        self.bias = None if bias_attr is False else Parameter(
+            rng.uniform(-bound, bound, (out_channels,)).astype(np.float32))
+        self._cfg = (stride, padding, dilation, groups)
+        self._subm = False
+
+    def forward(self, x):
+        sp = _sp()
+        stride, padding, dilation, groups = self._cfg
+        dense = x._bcoo.todense()  # [N, H, W, C]
+        lhs = jnp.moveaxis(dense, -1, 1)
+        rhs = jnp.transpose(self.weight._data, (3, 2, 0, 1))
+        st = (stride,) * 2 if isinstance(stride, int) else tuple(stride)
+        dl = (dilation,) * 2 if isinstance(dilation, int) else tuple(dilation)
+        if self._subm:
+            pads = [((k - 1) * d // 2, (k - 1) * d - (k - 1) * d // 2)
+                    for k, d in zip(rhs.shape[2:], dl)]
+            st = (1, 1)
+        elif isinstance(padding, int):
+            pads = [(padding, padding)] * 2
+        else:
+            pads = [(int(p), int(p)) if isinstance(p, (int, np.integer))
+                    else tuple(p) for p in padding]
+        out = jax.lax.conv_general_dilated(
+            lhs, rhs, window_strides=st, padding=pads, rhs_dilation=dl,
+            feature_group_count=groups)
+        out = jnp.moveaxis(out, 1, -1)
+        if self.bias is not None:
+            out = out + self.bias._data
+        if self._subm:
+            idx = _channels_dense(x).indices  # [nse, 3] over N,H,W
+            vals = out[tuple(idx.T)]
+            return sp.SparseCooTensor._wrap_bcoo(
+                jsparse.BCOO((vals, idx), shape=out.shape))
+        return sp.to_sparse_coo(Tensor._wrap(out))
+
+
+class SubmConv2D(Conv2D):
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self._subm = True
+
+
+class MaxPool3D(Layer):
+    """Sparse max pool over NDHWC (reference sparse max_pool3d)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC"):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+
+    def forward(self, x):
+        sp = _sp()
+        dense = x._bcoo.todense()  # [N, D, H, W, C]
+        ks = (self.kernel_size,) * 3 if isinstance(self.kernel_size, int) \
+            else tuple(self.kernel_size)
+        st = ks if self.stride is None else (
+            (self.stride,) * 3 if isinstance(self.stride, int)
+            else tuple(self.stride))
+        pd = (self.padding,) * 3 if isinstance(self.padding, int) \
+            else tuple(self.padding)
+        pads = [(0, 0)] + [(p, p) for p in pd] + [(0, 0)]
+        out = jax.lax.reduce_window(
+            dense, -jnp.inf, jax.lax.max,
+            (1,) + ks + (1,), (1,) + st + (1,), pads)
+        out = jnp.where(jnp.isfinite(out), out, 0.0)
+        return sp.to_sparse_coo(Tensor._wrap(out))
+
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm",
+           "SyncBatchNorm", "Conv2D", "Conv3D", "SubmConv2D", "SubmConv3D",
+           "MaxPool3D"]

@@ -1,0 +1,99 @@
+"""paddle.sparse parity tests (reference: python/paddle/sparse over phi
+sparse_coo kernels; test model: test/legacy_test/test_sparse_*_op.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sp
+
+
+def _coo():
+    return sp.sparse_coo_tensor([[0, 1, 2], [1, 2, 0]], [1.0, 2.0, 3.0],
+                                [3, 3])
+
+
+def test_unary_value_ops():
+    x = _coo()
+    np.testing.assert_allclose(sp.sqrt(x).values().numpy(),
+                               np.sqrt([1.0, 2.0, 3.0]), rtol=1e-6)
+    np.testing.assert_allclose(sp.square(x).values().numpy(), [1, 4, 9])
+    np.testing.assert_allclose(sp.neg(x).values().numpy(), [-1, -2, -3])
+    np.testing.assert_allclose(sp.pow(x, 2).values().numpy(), [1, 4, 9])
+    assert sp.cast(x, value_dtype="float16").values().numpy().dtype == \
+        np.float16
+    assert not sp.isnan(x).values().numpy().any()
+    # zero-preservation: dense of sin(x) matches sin of dense
+    np.testing.assert_allclose(sp.sin(x).to_dense().numpy(),
+                               np.sin(x.to_dense().numpy()), rtol=1e-6)
+
+
+def test_binary_and_matrix_ops():
+    x = _coo()
+    y = sp.sparse_coo_tensor([[0, 1, 2], [1, 2, 0]], [10.0, 20.0, 30.0],
+                             [3, 3])
+    np.testing.assert_allclose(sp.subtract(y, x).values().numpy(),
+                               [9, 18, 27])
+    np.testing.assert_allclose(sp.multiply(x, y).values().numpy(),
+                               [10, 40, 90])
+    np.testing.assert_allclose(sp.divide(y, x).values().numpy(),
+                               [10, 10, 10])
+    v = paddle.to_tensor([1.0, 1.0, 1.0])
+    np.testing.assert_allclose(sp.mv(x, v).numpy(), [1, 2, 3])
+    eye = paddle.to_tensor(np.eye(3, dtype=np.float32))
+    out = sp.addmm(eye, x, eye, beta=2.0, alpha=1.0).numpy()
+    np.testing.assert_allclose(out, 2 * np.eye(3) + x.to_dense().numpy())
+    mm = sp.masked_matmul(eye, eye, x)
+    np.testing.assert_allclose(mm.to_dense().numpy(),
+                               np.eye(3) * (x.to_dense().numpy() != 0))
+
+
+def test_structure_ops():
+    x = _coo()
+    np.testing.assert_allclose(sp.transpose(x, [1, 0]).to_dense().numpy(),
+                               x.to_dense().numpy().T)
+    np.testing.assert_allclose(sp.sum(x, 0).to_dense().numpy(),
+                               x.to_dense().numpy().sum(0))
+    assert float(sp.sum(x).numpy()) == 6.0
+    assert sp.coalesce(x).nnz() == 3
+    assert sp.is_same_shape(x, _coo())
+    np.testing.assert_allclose(
+        sp.reshape(x, [9, 1]).to_dense().numpy().ravel(),
+        x.to_dense().numpy().ravel())
+    sl = sp.slice(x, [0], [0], [2])
+    np.testing.assert_allclose(sl.to_dense().numpy(),
+                               x.to_dense().numpy()[:2])
+
+
+def test_pca_lowrank_reconstructs():
+    rng = np.random.RandomState(0)
+    base = rng.randn(8, 2) @ rng.randn(2, 6)
+    x = paddle.to_tensor(base.astype(np.float32))
+    u, s, v = sp.pca_lowrank(x, q=2, center=False)
+    rec = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+    np.testing.assert_allclose(rec, base, atol=1e-3)
+
+
+def test_nn_layers():
+    neg = sp.sparse_coo_tensor([[0, 1], [1, 0]], [-1.0, 2.0], [2, 2])
+    np.testing.assert_allclose(sp.nn.ReLU()(neg).values().numpy(), [0, 2])
+    x = _coo()
+    rows = sp.nn.Softmax()(x).to_dense().numpy().sum(1)
+    np.testing.assert_allclose(rows, [1, 1, 1], rtol=1e-6)
+    xs = sp.to_sparse_coo(paddle.to_tensor(
+        np.random.rand(2, 2, 2, 2, 4).astype(np.float32)))
+    bn = sp.nn.BatchNorm(4)
+    assert bn(xs).to_dense().shape == [2, 2, 2, 2, 4]
+    out = sp.nn.SubmConv3D(4, 8, 3)(xs)
+    assert out.to_dense().shape == [2, 2, 2, 2, 8]
+    assert sp.nn.MaxPool3D(2)(xs).to_dense().shape == [2, 1, 1, 1, 4]
+
+
+def test_submanifold_preserves_support():
+    dense = np.zeros((1, 4, 4, 4, 2), np.float32)
+    dense[0, 1, 1, 1] = 1.0
+    dense[0, 2, 3, 0] = 2.0
+    xs = sp.to_sparse_coo(paddle.to_tensor(dense), sparse_dim=4)
+    out = sp.nn.SubmConv3D(2, 3, 3)(xs)
+    od = out.to_dense().numpy()
+    mask = (np.abs(od).sum(-1) != 0)
+    in_mask = (np.abs(dense).sum(-1) != 0)
+    assert (mask == in_mask).all(), "submanifold support changed"
