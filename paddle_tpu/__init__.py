@@ -78,7 +78,8 @@ import paddle_tpu.linalg as linalg  # noqa: F401
 # heavier namespaces load lazily
 _LAZY = {"vision", "hapi", "profiler", "static", "models", "parallel",
          "incubate", "distribution", "sparse", "device", "inference",
-         "quantization", "utils", "text", "geometric"}
+         "quantization", "utils", "text", "geometric", "audio",
+         "regularizer", "sysconfig", "hub", "onnx", "tensor", "base"}
 import paddle_tpu.fft as fft  # noqa: F401
 import paddle_tpu.signal as signal  # noqa: F401
 

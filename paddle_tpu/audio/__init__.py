@@ -1,0 +1,12 @@
+"""paddle.audio equivalent (reference: python/paddle/audio): mel/window
+DSP functional, feature layers, wav IO."""
+from __future__ import annotations
+
+from . import backends  # noqa: F401
+from . import datasets  # noqa: F401
+from . import features  # noqa: F401
+from . import functional  # noqa: F401
+from .backends import info, load, save  # noqa: F401
+
+__all__ = ["functional", "features", "datasets", "backends", "load",
+           "info", "save"]

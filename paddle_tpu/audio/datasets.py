@@ -1,0 +1,53 @@
+"""paddle.audio.datasets (reference: python/paddle/audio/datasets):
+TESS / ESC50 over pre-placed files (no network egress here)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from paddle_tpu.io import Dataset
+
+
+class _AudioFolderDataset(Dataset):
+    _NAME = ""
+
+    def __init__(self, mode="train", feat_type="raw", data_dir=None,
+                 archive=None, **kw):
+        root = data_dir or os.path.expanduser(
+            f"~/.cache/paddle_tpu/{self._NAME}")
+        if not os.path.isdir(root):
+            raise FileNotFoundError(
+                f"{type(self).__name__} data not found at {root} "
+                "(no network access; place extracted wavs there)")
+        self.files = []
+        self.labels = []
+        for dirpath, _, files in os.walk(root):
+            for f in sorted(files):
+                if f.endswith(".wav"):
+                    self.files.append(os.path.join(dirpath, f))
+                    self.labels.append(os.path.basename(dirpath))
+        names = sorted(set(self.labels))
+        self.label_ids = {n: i for i, n in enumerate(names)}
+        self.feat_type = feat_type
+
+    def __len__(self):
+        return len(self.files)
+
+    def __getitem__(self, idx):
+        from .backends import load
+        wav, sr = load(self.files[idx])
+        return wav, np.int64(self.label_ids[self.labels[idx]])
+
+
+class TESS(_AudioFolderDataset):
+    _NAME = "tess"
+    n_class = 7
+
+
+class ESC50(_AudioFolderDataset):
+    _NAME = "esc50"
+    n_class = 50
+
+
+__all__ = ["TESS", "ESC50"]

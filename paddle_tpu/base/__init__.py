@@ -1,0 +1,22 @@
+"""paddle.base compatibility namespace (reference: python/paddle/base —
+the legacy fluid core). Re-exports the modern equivalents so code doing
+`from paddle.base import core` or `paddle.base.framework` keeps working."""
+from paddle_tpu.framework import core  # noqa: F401
+from paddle_tpu import framework  # noqa: F401
+from paddle_tpu.static import (  # noqa: F401
+    Executor, Program, default_main_program, default_startup_program,
+    global_scope, program_guard, scope_guard,
+)
+from paddle_tpu.core.place import (  # noqa: F401
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, CustomPlace, TPUPlace,
+)
+from paddle_tpu.core.tensor import Tensor  # noqa: F401
+from paddle_tpu.nn.layer.layers import ParamAttr  # noqa: F401
+
+
+def in_dygraph_mode():
+    return True
+
+
+def in_pir_mode():
+    return False

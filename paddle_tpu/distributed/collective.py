@@ -213,3 +213,22 @@ def batch_isend_irecv(p2p_op_list):
 def wait(tensor, group=None, use_calc_stream=True):
     tensor._data.block_until_ready()
     return tensor
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Single-controller semantics like scatter/all_gather above: every
+    rank's shard is this process's tensor (reference
+    communication/gather.py)."""
+    n = _world(group)
+    if gather_list is not None:
+        gather_list.clear()
+        gather_list.extend([Tensor._wrap(tensor._data) for _ in range(n)])
+    return _Task(tensor)
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    if in_object_list:
+        out_object_list.clear()
+        out_object_list.append(in_object_list[0])
+    return None

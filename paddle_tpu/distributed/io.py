@@ -1,0 +1,23 @@
+"""paddle.distributed.io (reference: python/paddle/distributed/io.py):
+save/load of distributed persistables — single-controller TPU variant
+delegates to paddle.save/load on rank 0."""
+from __future__ import annotations
+
+import os
+
+
+def is_persistable(var):
+    return getattr(var, "persistable", False)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    import paddle_tpu as paddle
+    os.makedirs(dirname, exist_ok=True)
+    pers = getattr(main_program, "_persistables", {}) if main_program \
+        else {}
+    paddle.save({k: v for k, v in pers.items()},
+                os.path.join(dirname, filename or "persistables.pdparams"))
+
+
+def load_inference_model_distributed(dirname, executor, **kw):
+    raise NotImplementedError("use paddle_tpu.jit.load")

@@ -1,0 +1,17 @@
+"""paddle.framework equivalent: io (save/load), core shim, misc."""
+from . import core  # noqa: F401
+from .io import load, save  # noqa: F401
+
+
+def get_default_dtype():
+    from paddle_tpu.core.dtype import get_default_dtype as g
+    return g()
+
+
+def set_default_dtype(d):
+    from paddle_tpu.core.dtype import set_default_dtype as s
+    return s(d)
+
+
+def in_dynamic_mode():
+    return True

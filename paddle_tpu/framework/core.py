@@ -1,0 +1,47 @@
+"""paddle.base.core shim (reference: the pybind `libpaddle` module,
+fluid/pybind/pybind.cc). Maps the commonly-touched core symbols onto the
+TPU-native runtime: places, TCPStore, RNG generator, flags."""
+from __future__ import annotations
+
+from paddle_tpu.core.place import (  # noqa: F401
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, CustomPlace, Place, TPUPlace,
+    XPUPlace,
+)
+from paddle_tpu.core.flags import get_flags, set_flags  # noqa: F401
+from paddle_tpu.core.generator import (  # noqa: F401
+    Generator, default_generator,
+)
+from paddle_tpu.native import TCPStore, BlockingQueue  # noqa: F401
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_custom_device(name="tpu"):
+    return name in ("tpu",)
+
+
+def get_cuda_device_count():
+    return 0
+
+
+def _get_paddle_place(place):
+    return place
+
+
+class VarDesc:
+    class VarType:
+        FP32 = "float32"
+        FP16 = "float16"
+        BF16 = "bfloat16"
+        FP64 = "float64"
+        INT32 = "int32"
+        INT64 = "int64"
+        BOOL = "bool"
+        UINT8 = "uint8"
+        INT8 = "int8"

@@ -278,3 +278,77 @@ class InputSpec:
         self.dtype = dtype_mod.convert_dtype(dtype)
         self.name = name
         self.stop_gradient = stop_gradient
+
+
+def _tree_split(vals):
+    """Split a pytree of Tensors into (jax leaves, rebuild fn)."""
+    from paddle_tpu.core.tensor import Tensor
+    leaves, treedef = jax.tree_util.tree_flatten(
+        vals, is_leaf=lambda v: isinstance(v, Tensor))
+    arrs = [v._data if isinstance(v, Tensor) else v for v in leaves]
+    was_tensor = [isinstance(v, Tensor) for v in leaves]
+
+    def rebuild(new_arrs):
+        new_leaves = [Tensor._wrap(a) if t else a
+                      for a, t in zip(new_arrs, was_tensor)]
+        return jax.tree_util.tree_unflatten(treedef, new_leaves)
+    return arrs, rebuild
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """paddle.static.nn.cond equivalent. Eager: a python branch. Under
+    trace (pred is a jax tracer): lax.cond, keeping the program
+    compilable — the PIR control-flow-dialect analog."""
+    from paddle_tpu.core.tensor import Tensor
+    p = pred._data if isinstance(pred, Tensor) else pred
+    try:
+        concrete = bool(p)
+    except jax.errors.TracerBoolConversionError:
+        out_t = true_fn()
+        if false_fn is None:
+            if out_t is None:
+                return None
+            raise ValueError(
+                "cond: false_fn is required under jit tracing when "
+                "true_fn returns a value (both branches of lax.cond "
+                "must produce the same structure)")
+        out_f = false_fn()
+        arrs_t, rebuild = _tree_split(out_t)
+        arrs_f, _ = _tree_split(out_f)
+        outs = jax.lax.cond(p.reshape(()),
+                            lambda: arrs_t, lambda: arrs_f)
+        return rebuild(outs)
+    return true_fn() if concrete else (false_fn() if false_fn else None)
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """paddle.static.nn.while_loop equivalent over lax.while_loop when
+    traced; a python loop when eager."""
+    from paddle_tpu.core.tensor import Tensor
+    vars_ = list(loop_vars)
+    p = cond_fn(*vars_)
+    parr = p._data if isinstance(p, Tensor) else p
+    try:
+        keep = bool(parr)
+        while keep:
+            out = body_fn(*vars_)
+            vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+            r = cond_fn(*vars_)
+            keep = bool(r._data if isinstance(r, Tensor) else r)
+        return vars_
+    except jax.errors.TracerBoolConversionError:
+        arrs, rebuild = _tree_split(vars_)
+
+        def c(a):
+            v = rebuild(a)
+            r = cond_fn(*v)
+            return (r._data if isinstance(r, Tensor) else r).reshape(())
+
+        def b(a):
+            v = rebuild(a)
+            out = body_fn(*v)
+            out = list(out) if isinstance(out, (list, tuple)) else [out]
+            new_arrs, _ = _tree_split(out)
+            return new_arrs
+        outs = jax.lax.while_loop(c, b, arrs)
+        return rebuild(outs)

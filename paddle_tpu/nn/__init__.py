@@ -54,3 +54,13 @@ from paddle_tpu.core.tensor import Parameter  # noqa: F401
 
 def initializer_set(*a, **k):
     pass
+
+
+from .layer.extended import (  # noqa: F401
+    AdaptiveLogSoftmaxWithLoss, BeamSearchDecoder, FeatureAlphaDropout,
+    FractionalMaxPool2D, FractionalMaxPool3D, GaussianNLLLoss,
+    HSigmoidLoss, LPPool1D, LPPool2D, MaxUnPool1D, MaxUnPool2D,
+    MaxUnPool3D, MultiMarginLoss, PairwiseDistance, PoissonNLLLoss,
+    RNNTLoss, Softmax2D, TripletMarginWithDistanceLoss, Unflatten,
+    ZeroPad1D, ZeroPad3D, dynamic_decode,
+)

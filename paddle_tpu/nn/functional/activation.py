@@ -210,3 +210,18 @@ def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
     return run_op("thresholded_relu",
                   lambda a: jnp.where(a > threshold, a,
                                       jnp.asarray(value, a.dtype)), x)
+
+
+def hardtanh_(x, min=-1.0, max=1.0, name=None):
+    from paddle_tpu.core.dispatch import rebind_inplace
+    return rebind_inplace(x, hardtanh(x, min, max))
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None):
+    from paddle_tpu.core.dispatch import rebind_inplace
+    return rebind_inplace(x, leaky_relu(x, negative_slope))
+
+
+def thresholded_relu_(x, threshold=1.0, value=0.0, name=None):
+    from paddle_tpu.core.dispatch import rebind_inplace
+    return rebind_inplace(x, thresholded_relu(x, threshold, value))

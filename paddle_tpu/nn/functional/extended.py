@@ -1,0 +1,692 @@
+"""Remaining paddle.nn.functional surface (reference:
+python/paddle/nn/functional/{loss,common,pooling,vision,extension}.py).
+
+All ops are single XLA-traceable jnp functions through run_op (dispatch
+doc in core/dispatch.py); anything with data-dependent structure
+(fractional pooling boundaries, adaptive softmax clusters, hsigmoid paths)
+precomputes static index tables in numpy so XLA sees fixed shapes.
+"""
+from __future__ import annotations
+
+import math as _math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.dispatch import run_op
+from paddle_tpu.core.tensor import Tensor
+
+
+def _t(x):
+    import paddle_tpu as paddle
+    return x if isinstance(x, Tensor) else paddle.to_tensor(x)
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# distances
+# ---------------------------------------------------------------------------
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """||x - y + eps||_p along the last axis (reference
+    nn/functional/distance.py pairwise_distance)."""
+    def f(a, b):
+        d = a - b + epsilon
+        if p == 2.0:
+            out = jnp.sqrt(jnp.sum(d * d, -1))
+        elif np.isinf(p):
+            out = jnp.max(jnp.abs(d), -1)
+        else:
+            out = jnp.sum(jnp.abs(d) ** p, -1) ** (1.0 / p)
+        return out[..., None] if keepdim else out
+    return run_op("pairwise_distance", f, _t(x), _t(y))
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean", name=None):
+    def f(a, b):
+        if log_input:
+            out = jnp.exp(a) - b * a
+        else:
+            out = a - b * jnp.log(a + epsilon)
+        if full:
+            stirling = b * jnp.log(b) - b + 0.5 * jnp.log(2 * np.pi * b)
+            out = out + jnp.where(b > 1, stirling, 0.0)
+        return _reduce(out, reduction)
+    return run_op("poisson_nll_loss", f, _t(input), _t(label))
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def f(a, b, var):
+        var = jnp.maximum(var, epsilon)
+        out = 0.5 * (jnp.log(var) + (a - b) ** 2 / var)
+        if full:
+            out = out + 0.5 * np.log(2 * np.pi)
+        return _reduce(out, reduction)
+    return run_op("gaussian_nll_loss", f, _t(input), _t(label), _t(variance))
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    def f(a, y, *w):
+        n, c = a.shape
+        y = y.astype(jnp.int32)
+        xy = jnp.take_along_axis(a, y[:, None], 1)       # [N,1]
+        m = jnp.maximum(0.0, margin - xy + a)
+        if p != 1:
+            m = m ** p
+        if w:
+            m = m * w[0][y][:, None]
+        mask = jnp.ones_like(m).at[jnp.arange(n), y].set(0.0)
+        out = jnp.sum(m * mask, 1) / c
+        return _reduce(out, reduction)
+    args = [_t(input), _t(label)] + ([_t(weight)] if weight is not None
+                                     else [])
+    return run_op("multi_margin_loss", f, *args)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    dist = distance_function
+    if dist is None:
+        def dist(a, b):
+            import paddle_tpu as paddle
+            return paddle.norm(a - b, p=2, axis=-1)
+    dp = _t(dist(_t(input), _t(positive)))
+    dn = _t(dist(_t(input), _t(negative)))
+    if swap:
+        dpn = _t(dist(_t(positive), _t(negative)))
+        def g(n1, pn):
+            return jnp.minimum(n1, pn)
+        dn = run_op("min_swap", g, dn, dpn)
+
+    def f(p_, n_):
+        return _reduce(jnp.maximum(0.0, p_ - n_ + margin), reduction)
+    return run_op("triplet_margin_with_distance_loss", f, dp, dn)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss over a complete binary tree (reference
+    nn/functional/loss.py hsigmoid_loss -> phi hsigmoid_loss kernel).
+
+    Default tree: internal nodes form a heap (root 0, children 2i+1/2i+2),
+    leaf for class c is heap id c + num_classes - 1. Static per-class
+    path/code tables are precomputed host-side."""
+    n_internal = num_classes - 1
+    depth = max(1, int(np.ceil(np.log2(max(num_classes, 2)))))
+    if path_table is None:
+        tbl = np.zeros((num_classes, depth), np.int32)
+        code = np.zeros((num_classes, depth), np.float32)
+        valid = np.zeros((num_classes, depth), np.float32)
+        for c in range(num_classes):
+            node = c + n_internal          # leaf heap id
+            path = []
+            bits = []
+            while node != 0:
+                parent = (node - 1) // 2
+                path.append(parent)
+                bits.append(float(node == 2 * parent + 2))  # right child?
+                node = parent
+            path.reverse()
+            bits.reverse()
+            tbl[c, :len(path)] = path
+            code[c, :len(bits)] = bits
+            valid[c, :len(path)] = 1.0
+    else:
+        tbl = np.asarray(path_table.numpy() if isinstance(path_table, Tensor)
+                         else path_table, np.int32)
+        code = np.asarray(path_code.numpy() if isinstance(path_code, Tensor)
+                          else path_code, np.float32)
+        valid = (tbl >= 0).astype(np.float32)
+        tbl = np.maximum(tbl, 0)
+        depth = tbl.shape[1]
+
+    def f(x, y, w, *b):
+        y = y.reshape(-1).astype(jnp.int32)
+        p = jnp.asarray(tbl)[y]            # [N, depth]
+        cde = jnp.asarray(code)[y]         # [N, depth]
+        vld = jnp.asarray(valid)[y]
+        wn = w[p]                          # [N, depth, F]
+        logits = jnp.einsum("ndf,nf->nd", wn, x)
+        if b:
+            logits = logits + b[0].reshape(-1)[p]
+        # sigmoid CE with target = code bit
+        losses = jnp.maximum(logits, 0) - logits * cde + \
+            jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        return jnp.sum(losses * vld, 1, keepdims=True)
+    args = [_t(input), _t(label), _t(weight)]
+    if bias is not None:
+        args.append(_t(bias))
+    return run_op("hsigmoid_loss", f, *args)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-Transducer loss (reference nn/functional/loss.py rnnt_loss ->
+    warprnnt). TPU-native: the alpha DP runs as a lax.scan over T with an
+    inner associative row-recurrence over U, all static shapes."""
+    def f(logits, labels, in_lens, lab_lens):
+        # logits: [B, T, U+1, V] log-probs expected by warprnnt after
+        # log_softmax; apply it here for robustness
+        logp = jax.nn.log_softmax(logits, -1)
+        b_, t_, u1, v = logp.shape
+        u_ = u1 - 1
+        labels = labels.astype(jnp.int32)
+        blank_lp = logp[..., blank]                        # [B,T,U+1]
+        emit_lp = jnp.take_along_axis(
+            logp[:, :, :u_, :], labels[:, None, :, None], 3)[..., 0]
+        # [B,T,U] emit label u at (t,u)
+        neg_inf = jnp.asarray(-1e30, logp.dtype)
+
+        def row(alpha_prev, t):
+            # alpha_prev: [B, U+1] at time t-1 -> alpha at t
+            from_blank = alpha_prev + blank_lp[:, t - 1, :]
+            # within-row emit recurrence: alpha[t,u] gets
+            # alpha[t,u-1] + emit(t, u-1)
+
+            def emit_scan(carry, u):
+                cur = jnp.logaddexp(from_blank[:, u],
+                                    carry + emit_lp[:, t, u - 1])
+                return cur, cur
+            init = from_blank[:, 0]
+            _, rest = lax.scan(emit_scan, init, jnp.arange(1, u1))
+            alpha = jnp.concatenate([init[:, None], rest.T], 1)
+            return alpha, alpha
+
+        # t = 0 row: only emits along u
+        def emit0(carry, u):
+            cur = carry + emit_lp[:, 0, u - 1]
+            return cur, cur
+        a0_init = jnp.zeros((b_,), logp.dtype)
+        _, rest0 = lax.scan(emit0, a0_init, jnp.arange(1, u1))
+        alpha0 = jnp.concatenate([a0_init[:, None], rest0.T], 1)
+
+        def step(alpha_prev, t):
+            alpha = row(alpha_prev, t)[0]
+            return alpha, alpha
+        _, alphas = lax.scan(step, alpha0, jnp.arange(1, t_))
+        alphas = jnp.concatenate([alpha0[None], alphas], 0)  # [T,B,U+1]
+        alphas = jnp.moveaxis(alphas, 1, 0)                  # [B,T,U+1]
+        tl = in_lens.astype(jnp.int32) - 1
+        ul = lab_lens.astype(jnp.int32)
+        a_end = alphas[jnp.arange(b_), tl, ul]
+        ll = a_end + blank_lp[jnp.arange(b_), tl, ul]
+        out = -ll
+        if reduction == "mean":
+            return jnp.mean(out)
+        if reduction == "sum":
+            return jnp.sum(out)
+        return out
+    return run_op("rnnt_loss", f, _t(input), _t(label), _t(input_lengths),
+                  _t(label_lengths))
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Adaptive softmax (Grave et al.) — reference
+    nn/functional/loss.py adaptive_log_softmax_with_loss. Head covers
+    [0, cutoffs[0]) + one slot per tail cluster; each tail cluster c
+    projects to its own (down-projected) vocabulary chunk."""
+    cutoffs = list(cutoffs)
+    n_clusters = len(cutoffs) - 1 if cutoffs and cutoffs[-1] is not None \
+        else len(cutoffs)
+    # paddle passes cutoffs without the vocab size; normalize
+    tails = [(
+        _t(tail_weights[i][0]) if isinstance(tail_weights[i],
+                                             (list, tuple))
+        else _t(tail_weights[i]),
+        _t(tail_weights[i][1]) if isinstance(tail_weights[i],
+                                             (list, tuple)) else None)
+        for i in range(len(tail_weights))]
+
+    x, y = _t(input), _t(label)
+    hw = _t(head_weight)
+    hb = _t(head_bias) if head_bias is not None else None
+
+    def f(xa, ya, hwa, *rest):
+        i = 0
+        hba = None
+        if hb is not None:
+            hba = rest[0]
+            i = 1
+        tail_ws = rest[i:]
+        shortlist = cutoffs[0]
+        head_logits = xa @ hwa
+        if hba is not None:
+            head_logits = head_logits + hba
+        head_lp = jax.nn.log_softmax(head_logits, -1)
+        ya_i = ya.astype(jnp.int32)
+        n = xa.shape[0]
+        # default: token in shortlist
+        out = head_lp[jnp.arange(n), jnp.minimum(ya_i, shortlist - 1)]
+        lo = shortlist
+        for c, tw in enumerate(tail_ws):
+            hi = cutoffs[c + 1] if c + 1 < len(cutoffs) else None
+            if hi is None:
+                break
+            in_c = (ya_i >= lo) & (ya_i < hi)
+            proj = tw[0] if isinstance(tw, tuple) else tw
+            tail_lp = jax.nn.log_softmax(xa @ proj, -1)
+            rel = jnp.clip(ya_i - lo, 0, tail_lp.shape[1] - 1)
+            cluster_lp = head_lp[:, shortlist + c] + \
+                tail_lp[jnp.arange(n), rel]
+            out = jnp.where(in_c, cluster_lp, out)
+            lo = hi
+        loss = -jnp.mean(out)
+        return out, loss
+    args = [x, y, hw] + ([hb] if hb is not None else []) + \
+        [tw for tw, _ in tails]
+    return run_op("adaptive_log_softmax_with_loss", f, *args, n_outputs=2)
+
+
+# ---------------------------------------------------------------------------
+# dropout / padding
+# ---------------------------------------------------------------------------
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout that drops whole channels (dim 1), keeping SELU
+    self-normalizing statistics (reference feature_alpha_dropout)."""
+    if not training or p == 0.0:
+        return _t(x)
+    from paddle_tpu.core.generator import default_generator
+    key = default_generator().next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def f(a):
+        shape = (a.shape[0], a.shape[1]) + (1,) * (a.ndim - 2)
+        keep = jax.random.bernoulli(key, 1 - p, shape)
+        av = 1.0 / _math.sqrt((alpha_p ** 2 * p + 1) * (1 - p))
+        bv = -av * alpha_p * p
+        return (jnp.where(keep, a, alpha_p) * av + bv).astype(a.dtype)
+    return run_op("feature_alpha_dropout", f, _t(x))
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    pl, pr, pt, pb = [int(p) for p in padding]
+
+    def f(a):
+        if data_format == "NCHW":
+            cfg = [(0, 0), (0, 0), (pt, pb), (pl, pr)]
+        else:
+            cfg = [(0, 0), (pt, pb), (pl, pr), (0, 0)]
+        return jnp.pad(a, cfg)
+    return run_op("zeropad2d", f, _t(x))
+
+
+# ---------------------------------------------------------------------------
+# unpooling / fractional pooling
+# ---------------------------------------------------------------------------
+
+def _max_unpool(x, indices, n, kernel_size, stride, padding, output_size,
+                data_format):
+    def f(a, idx):
+        spatial_in = a.shape[2:]
+        if output_size is not None:
+            out_sp = tuple(int(s) for s in output_size[-n:])
+        else:
+            ks = (kernel_size,) * n if isinstance(kernel_size, int) \
+                else tuple(kernel_size)
+            st = ks if stride is None else (
+                (stride,) * n if isinstance(stride, int) else tuple(stride))
+            pd = (padding,) * n if isinstance(padding, int) \
+                else tuple(padding)
+            out_sp = tuple((si - 1) * s + k - 2 * p for si, s, k, p in
+                           zip(spatial_in, st, ks, pd))
+        nb, c = a.shape[:2]
+        flat_sz = int(np.prod(out_sp))
+        flat = jnp.zeros((nb, c, flat_sz), a.dtype)
+        ii = idx.reshape(nb, c, -1).astype(jnp.int32)
+        vv = a.reshape(nb, c, -1)
+        flat = flat.at[jnp.arange(nb)[:, None, None],
+                       jnp.arange(c)[None, :, None], ii].set(vv)
+        return flat.reshape((nb, c) + out_sp)
+    return run_op("max_unpool", f, _t(x), _t(indices))
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, 1, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 2, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 3, kernel_size, stride, padding,
+                       output_size, data_format)
+
+
+def _fractional_bounds(in_sz, out_sz, u):
+    """Graham fractional pooling boundaries: a_i = ceil(alpha*(i+u)) with
+    alpha = in/out; static table per (in,out,u)."""
+    alpha = in_sz / out_sz
+    idx = np.arange(out_sz + 1)
+    b = np.ceil(alpha * (idx + u)).astype(np.int64) - \
+        int(np.ceil(alpha * u))
+    b = np.clip(b, 0, in_sz)
+    b[-1] = in_sz
+    return b
+
+
+def _fractional_pool(x, n, output_size, kernel_size, random_u, name):
+    import paddle_tpu as paddle
+    u = float(random_u) if random_u is not None else \
+        float(np.random.RandomState(0).uniform(0, 1))
+    xt = _t(x)
+    out_sp = (output_size,) * n if isinstance(output_size, int) \
+        else tuple(int(s) for s in output_size[-n:])
+    in_sp = xt.shape[2:]
+    bounds = [_fractional_bounds(i, o, u) for i, o in zip(in_sp, out_sp)]
+
+    def f(a):
+        def pool_axis(arr, axis, b):
+            pieces = []
+            for i in range(len(b) - 1):
+                s, e = int(b[i]), int(b[i + 1])
+                e = max(e, s + 1)
+                sl = [slice(None)] * arr.ndim
+                sl[axis] = slice(s, min(e, arr.shape[axis]))
+                pieces.append(jnp.max(arr[tuple(sl)], axis=axis,
+                                      keepdims=True))
+            return jnp.concatenate(pieces, axis)
+        out = a
+        for d in range(n):
+            out = pool_axis(out, 2 + d, bounds[d])
+        return out
+    return run_op(name, f, xt)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    out = _fractional_pool(x, 2, output_size, kernel_size, random_u,
+                           "fractional_max_pool2d")
+    if return_mask:
+        import paddle_tpu as paddle
+        return out, paddle.zeros(out.shape, dtype="int64")
+    return out
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    out = _fractional_pool(x, 3, output_size, kernel_size, random_u,
+                           "fractional_max_pool3d")
+    if return_mask:
+        import paddle_tpu as paddle
+        return out, paddle.zeros(out.shape, dtype="int64")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# vision: affine_grid / grid_sample
+# ---------------------------------------------------------------------------
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2-D/3-D affine sampling grid (reference nn/functional/vision.py
+    affine_grid)."""
+    out_shape = [int(s) for s in (out_shape.tolist()
+                                  if isinstance(out_shape, Tensor)
+                                  else out_shape)]
+
+    def f(th):
+        def line(n):
+            if align_corners:
+                return jnp.linspace(-1.0, 1.0, n)
+            step = 2.0 / n
+            return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, n)
+        if len(out_shape) == 4:
+            nb, _, h, w = out_shape
+            ys, xs = jnp.meshgrid(line(h), line(w), indexing="ij")
+            base = jnp.stack([xs, ys, jnp.ones_like(xs)], -1)  # [H,W,3]
+            grid = jnp.einsum("hwk,njk->nhwj", base, th)       # [N,H,W,2]
+            return grid
+        nb, _, d, h, w = out_shape
+        zs, ys, xs = jnp.meshgrid(line(d), line(h), line(w), indexing="ij")
+        base = jnp.stack([xs, ys, zs, jnp.ones_like(xs)], -1)
+        return jnp.einsum("dhwk,njk->ndhwj", base, th)
+    return run_op("affine_grid", f, _t(theta))
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample x at normalized grid locations (reference grid_sample
+    kernel). Gather-based; XLA lowers to dynamic-gather."""
+    def f(a, g):
+        n, c, h, w = a.shape
+        gx, gy = g[..., 0], g[..., 1]
+
+        def unnorm(v, size):
+            if align_corners:
+                return (v + 1) * (size - 1) / 2
+            return ((v + 1) * size - 1) / 2
+        fx, fy = unnorm(gx, w), unnorm(gy, h)
+
+        def sample(ix, iy):
+            if padding_mode == "border":
+                ix = jnp.clip(ix, 0, w - 1)
+                iy = jnp.clip(iy, 0, h - 1)
+                valid = jnp.ones_like(ix, bool)
+            elif padding_mode == "reflection":
+                def refl(v, size):
+                    if align_corners:
+                        span = 2 * (size - 1)
+                        v = jnp.abs(v) % jnp.maximum(span, 1)
+                        return jnp.where(v >= size, span - v, v)
+                    span = 2 * size
+                    v = (jnp.abs(v + 0.5) % jnp.maximum(span, 1))
+                    v = jnp.where(v >= size, span - v, v) - 0.5
+                    return jnp.clip(v, 0, size - 1)
+                ix = refl(ix, w)
+                iy = refl(iy, h)
+                valid = jnp.ones_like(ix, bool)
+            else:
+                valid = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+                ix = jnp.clip(ix, 0, w - 1)
+                iy = jnp.clip(iy, 0, h - 1)
+            ii = iy.astype(jnp.int32)
+            jj = ix.astype(jnp.int32)
+            out = a[jnp.arange(n)[:, None, None], :, ii, jj]
+            # -> [N, Ho, Wo, C]
+            return jnp.where(valid[..., None], out, 0.0)
+
+        if mode == "nearest":
+            out = sample(jnp.round(fx), jnp.round(fy))
+        else:
+            x0, y0 = jnp.floor(fx), jnp.floor(fy)
+            x1, y1 = x0 + 1, y0 + 1
+            wa = (x1 - fx) * (y1 - fy)
+            wb = (x1 - fx) * (fy - y0)
+            wc = (fx - x0) * (y1 - fy)
+            wd = (fx - x0) * (fy - y0)
+            out = (sample(x0, y0) * wa[..., None]
+                   + sample(x0, y1) * wb[..., None]
+                   + sample(x1, y0) * wc[..., None]
+                   + sample(x1, y1) * wd[..., None])
+        return jnp.moveaxis(out, -1, 1).astype(a.dtype)
+    return run_op("grid_sample", f, _t(x), _t(grid))
+
+
+# ---------------------------------------------------------------------------
+# misc extension ops
+# ---------------------------------------------------------------------------
+
+def class_center_sample(label, num_classes, num_samples, group=None,
+                        name=None):
+    """Sample class centers: all positive classes + random negatives up to
+    num_samples (reference class_center_sample op used by margin losses;
+    single-process semantics here — the distributed variant shards classes
+    over the mp group)."""
+    lab = _t(label)
+    lab_np = np.asarray(lab.numpy(), np.int64)
+    pos = np.unique(lab_np)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        rest = np.setdiff1d(np.arange(num_classes), pos)
+        rng = np.random.RandomState(0)
+        extra = rng.choice(rest, num_samples - len(pos), replace=False)
+        sampled = np.concatenate([pos, extra])
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    import paddle_tpu as paddle
+    return (paddle.to_tensor(remap[lab_np]),
+            paddle.to_tensor(np.sort(sampled) if False else sampled))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block-sparse attention given a CSR pattern (reference
+    sparse_attention op). TPU-native: materialize the mask from CSR and
+    run masked attention — XLA fuses the where into the softmax; the CUDA
+    original needs hand-written block kernels."""
+    def f(q, k, v, off, cols):
+        nb, nh, seq, dk = q.shape
+        scores = jnp.einsum("nhqd,nhkd->nhqk", q, k) / np.sqrt(dk)
+        mask = jnp.zeros((nb, nh, seq, seq), bool)
+        offs = off.astype(jnp.int32)
+        colns = cols.astype(jnp.int32)
+        # build row mask from CSR (static loop over rows)
+        counts = offs[..., 1:] - offs[..., :-1]          # [nb,nh,seq]
+        max_nnz = colns.shape[-1]
+        pos = jnp.arange(max_nnz)
+        for r in range(seq):
+            start = offs[..., r]
+            cnt = counts[..., r]
+            sel = (pos[None, None, :] >= start[..., None]) & \
+                  (pos[None, None, :] < (start + cnt)[..., None])
+            cols_r = jnp.where(sel, colns, -1)
+            row_mask = jnp.zeros((nb, nh, seq + 1), bool)
+            row_mask = row_mask.at[
+                jnp.arange(nb)[:, None, None],
+                jnp.arange(nh)[None, :, None],
+                jnp.where(cols_r >= 0, cols_r, seq)].set(True)
+            mask = mask.at[:, :, r, :].set(row_mask[..., :seq])
+        scores = jnp.where(mask, scores, -1e30)
+        attn = jax.nn.softmax(scores, -1)
+        return jnp.einsum("nhqk,nhkd->nhqd", attn, v)
+    return run_op("sparse_attention", f, _t(query), _t(key), _t(value),
+                  _t(sparse_csr_offset), _t(sparse_csr_columns))
+
+
+def gather_tree(ids, parents):
+    from paddle_tpu.ops.extra import gather_tree as _gt
+    return _gt(ids, parents)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    from paddle_tpu.ops.vision_ops import temporal_shift as _ts
+    return _ts(x, seg_num, shift_ratio, data_format)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction=None, name=None):
+    from paddle_tpu.ops.extra import margin_cross_entropy as _mce
+    out = _mce(logits, label, margin1, margin2, margin3, scale,
+               return_softmax=return_softmax)
+    if reduction is None:
+        return out
+    loss = out[0] if return_softmax else out
+    import paddle_tpu as paddle
+    red = paddle.mean(loss) if reduction == "mean" else paddle.sum(loss)
+    return (red, out[1]) if return_softmax else red
+
+
+# ---------------------------------------------------------------------------
+# flash-attention packed variants (reference
+# nn/functional/flash_attention.py): same Pallas/XLA path as
+# flash_attention, different packing
+# ---------------------------------------------------------------------------
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False,
+                         return_softmax=False, name=None):
+    """qkv: [B, S, 3, H, D] packed (reference flash_attn_qkvpacked)."""
+    from .attention import flash_attention
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                           return_softmax=return_softmax)
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q, max_seqlen_k, scale=None,
+                                dropout=0.0, causal=False,
+                                return_softmax=False, name=None):
+    """Varlen packed qkv: [total_tokens, 3, H, D] + cumulative lengths
+    (reference flash_attn_varlen_qkvpacked)."""
+    from .attention import flash_attn_unpadded
+    q = qkv[:, 0]
+    k = qkv[:, 1]
+    v = qkv[:, 2]
+    return flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                               max_seqlen_q, max_seqlen_k, scale=scale,
+                               dropout=dropout, causal=causal,
+                               return_softmax=return_softmax)
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None,
+                        dropout=0.0, causal=False, window_size=None,
+                        return_softmax_lse=False, return_seed_offset=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """FlashMask attention (reference incubate flashmask_attention):
+    attention with per-row start/end column masks. XLA path: build the
+    sparse row mask and fuse into softmax."""
+    q, k, v = _t(query), _t(key), _t(value)
+
+    def f(qa, ka, va, *rows):
+        b, sq, h, d = qa.shape
+        sk = ka.shape[1]
+        qh = jnp.moveaxis(qa, 1, 2)
+        kh = jnp.moveaxis(ka, 1, 2)
+        vh = jnp.moveaxis(va, 1, 2)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / np.sqrt(d)
+        cols = jnp.arange(sk)
+        if causal:
+            scores = jnp.where(cols[None, None, None, :]
+                               <= jnp.arange(sq)[None, None, :, None],
+                               scores, -1e30)
+        if rows:
+            sr = rows[0]          # [B, H or 1, S, n] start/end row indices
+            # flashmask semantics: cols in [start, end) are masked OUT
+            start = sr[..., 0]
+            end = sr[..., 1] if sr.shape[-1] > 1 else \
+                jnp.full_like(start, sk)
+            masked = (cols[None, None, None, :] >=
+                      start[..., :, None]) & \
+                     (cols[None, None, None, :] < end[..., :, None])
+            scores = jnp.where(masked, -1e30, scores)
+        attn = jax.nn.softmax(scores, -1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", attn, vh)
+        return jnp.moveaxis(out, 2, 1)
+    args = [q, k, v]
+    if startend_row_indices is not None:
+        args.append(_t(startend_row_indices))
+    return run_op("flashmask_attention", f, *args)

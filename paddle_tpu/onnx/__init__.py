@@ -1,0 +1,36 @@
+"""paddle.onnx (reference: python/paddle/onnx/export.py, which delegates
+to paddle2onnx). The TPU-native interchange format is StableHLO — the
+XLA-world equivalent of ONNX — so export() writes the jitted program's
+StableHLO text; ONNX-proto emission needs the (absent) onnx package."""
+from __future__ import annotations
+
+import os
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.core.tensor import Tensor
+
+    specs = input_spec or []
+    example = []
+    for s in specs:
+        shape = [1 if d in (-1, None) else int(d) for d in s.shape]
+        example.append(jnp.zeros(shape, getattr(s, "dtype", "float32")))
+
+    def fn(*xs):
+        outs = layer(*[Tensor._wrap(x) for x in xs])
+        if isinstance(outs, (list, tuple)):
+            return [o._data for o in outs]
+        return outs._data
+
+    lowered = jax.jit(fn).lower(*example)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    out_path = path if path.endswith(".mlir") else path + ".stablehlo.mlir"
+    with open(out_path, "w") as f:
+        f.write(lowered.as_text())
+    return out_path
+
+
+__all__ = ["export"]

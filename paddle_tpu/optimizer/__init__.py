@@ -2,5 +2,6 @@
 from . import lr  # noqa: F401
 from .optimizer import Optimizer  # noqa: F401
 from .optimizers import (  # noqa: F401
-    SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, RMSProp,
+    SGD, ASGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, LBFGS,
+    Momentum, NAdam, RAdam, RMSProp, Rprop,
 )

@@ -309,3 +309,250 @@ class Lamb(Optimizer):
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
         lr = self._lr_for(p).astype(cdt)
         self._apply_update(p, val - lr * trust * r)
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (reference optimizer/asgd.py — phi asgd kernel):
+    keeps a running sum `d` of the last n gradients via a circular
+    buffer `ys`; param -= lr * d / n."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._n = max(int(batch_num), 1)
+
+    def _create_accumulators(self):
+        for p in self._parameter_list:
+            self._add_accumulator("d", p)
+            self._add_accumulator("ys", p, shape=(self._n,)
+                                  + tuple(p._data.shape))
+
+    def _append_optimize_op(self, p, g):
+        val = self._param_value(p)
+        gd = self._decayed(p, val, g._data.astype(val.dtype))
+        d = self._get_accumulator("d", p)
+        ys = self._get_accumulator("ys", p)
+        idx = self._global_step % self._n
+        old = ys._data[idx].astype(val.dtype)
+        new_d = d._data.astype(val.dtype) - old + gd
+        d._assign_array(new_d.astype(d._data.dtype))
+        ys._assign_array(ys._data.at[idx].set(gd.astype(ys._data.dtype)))
+        n_eff = min(self._global_step + 1, self._n)
+        lr = self._lr_for(p).astype(val.dtype)
+        self._apply_update(p, val - lr * new_d / n_eff)
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (reference optimizer/rprop.py): per-weight step
+    sizes grown/shrunk by the sign agreement of successive gradients."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+        self._initial_lr = float(learning_rate)
+
+    def _create_accumulators(self):
+        for p in self._parameter_list:
+            self._add_accumulator("prev_grad", p)
+            self._add_accumulator("step_size", p, fill=self._initial_lr)
+
+    def _append_optimize_op(self, p, g):
+        val = self._param_value(p)
+        gd = g._data.astype(val.dtype)
+        prev = self._get_accumulator("prev_grad", p)
+        step = self._get_accumulator("step_size", p)
+        sign = jnp.sign(gd * prev._data.astype(val.dtype))
+        factor = jnp.where(sign > 0, self._eta_pos,
+                           jnp.where(sign < 0, self._eta_neg, 1.0))
+        new_step = jnp.clip(step._data.astype(val.dtype) * factor,
+                            self._lr_min, self._lr_max)
+        # on sign change: zero the gradient (do not step through)
+        eff_g = jnp.where(sign < 0, 0.0, gd)
+        prev._assign_array(eff_g.astype(prev._data.dtype))
+        step._assign_array(new_step.astype(step._data.dtype))
+        self._apply_update(p, val - jnp.sign(eff_g) * new_step)
+
+
+class RAdam(Optimizer):
+    """Rectified Adam (reference optimizer/radam.py): variance-rectified
+    warmup of the adaptive term."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _create_accumulators(self):
+        for p in self._parameter_list:
+            self._add_accumulator("m", p)
+            self._add_accumulator("v", p)
+
+    def _append_optimize_op(self, p, g):
+        val = self._param_value(p)
+        gd = self._decayed(p, val, g._data.astype(val.dtype))
+        m = self._get_accumulator("m", p)
+        v = self._get_accumulator("v", p)
+        b1, b2 = self._beta1, self._beta2
+        t = self._global_step + 1
+        new_m = b1 * m._data.astype(val.dtype) + (1 - b1) * gd
+        new_v = b2 * v._data.astype(val.dtype) + (1 - b2) * gd * gd
+        m._assign_array(new_m.astype(m._data.dtype))
+        v._assign_array(new_v.astype(v._data.dtype))
+        rho_inf = 2.0 / (1 - b2) - 1
+        rho_t = rho_inf - 2.0 * t * b2 ** t / (1 - b2 ** t)
+        m_hat = new_m / (1 - b1 ** t)
+        lr = self._lr_for(p).astype(val.dtype)
+        if rho_t > 5.0:
+            r = (((rho_t - 4) * (rho_t - 2) * rho_inf)
+                 / ((rho_inf - 4) * (rho_inf - 2) * rho_t)) ** 0.5
+            v_hat = jnp.sqrt(new_v / (1 - b2 ** t)) + self._eps
+            self._apply_update(p, val - lr * r * m_hat / v_hat)
+        else:
+            self._apply_update(p, val - lr * m_hat)
+
+
+class NAdam(Optimizer):
+    """Nesterov Adam (reference optimizer/nadam.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._psi = momentum_decay
+        self._mu_prod = 1.0
+
+    def _create_accumulators(self):
+        for p in self._parameter_list:
+            self._add_accumulator("m", p)
+            self._add_accumulator("v", p)
+
+    def step(self):
+        t = self._global_step + 1
+        b1 = self._beta1
+        self._mu_t = b1 * (1 - 0.5 * 0.96 ** (t * self._psi))
+        self._mu_t1 = b1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        self._mu_prod_t = self._mu_prod * self._mu_t
+        self._mu_prod_t1 = self._mu_prod_t * self._mu_t1
+        super().step()
+        self._mu_prod = self._mu_prod_t
+
+    def _append_optimize_op(self, p, g):
+        val = self._param_value(p)
+        gd = self._decayed(p, val, g._data.astype(val.dtype))
+        m = self._get_accumulator("m", p)
+        v = self._get_accumulator("v", p)
+        b1, b2 = self._beta1, self._beta2
+        t = self._global_step + 1
+        new_m = b1 * m._data.astype(val.dtype) + (1 - b1) * gd
+        new_v = b2 * v._data.astype(val.dtype) + (1 - b2) * gd * gd
+        m._assign_array(new_m.astype(m._data.dtype))
+        v._assign_array(new_v.astype(v._data.dtype))
+        m_hat = (self._mu_t1 * new_m / (1 - self._mu_prod_t1)
+                 + (1 - self._mu_t) * gd / (1 - self._mu_prod_t))
+        v_hat = new_v / (1 - b2 ** t)
+        lr = self._lr_for(p).astype(val.dtype)
+        self._apply_update(
+            p, val - lr * m_hat / (jnp.sqrt(v_hat) + self._eps))
+
+
+class LBFGS(Optimizer):
+    """L-BFGS with closure interface (reference optimizer/lbfgs.py):
+    two-loop recursion over a bounded (s, y) history; step(closure)
+    re-evaluates the loss."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._max_iter = max_iter
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._history = history_size
+        self._s, self._y = [], []
+        self._prev_flat_g = None
+        self._prev_loss = None
+
+    def _flat_grad(self):
+        # route through the base-class plumbing so grad_clip and
+        # weight_decay apply exactly as in every other optimizer
+        clipped = {id(p): g for p, g in self._grads()}
+        gs = []
+        for p in self._parameter_list:
+            g = clipped.get(id(p))
+            if g is None:
+                gs.append(jnp.zeros(p._data.size, jnp.float32))
+            else:
+                gd = self._decayed(p, self._param_value(p),
+                                   g._data.astype(jnp.float32))
+                gs.append(gd.reshape(-1))
+        return jnp.concatenate(gs)
+
+    def _flat_params(self):
+        return jnp.concatenate([p._data.astype(jnp.float32).reshape(-1)
+                                for p in self._parameter_list])
+
+    def _set_flat_params(self, flat):
+        off = 0
+        for p in self._parameter_list:
+            n = p._data.size
+            newv = flat[off:off + n].reshape(p._data.shape)
+            p._assign_array(newv.astype(p._data.dtype))
+            off += n
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure returning "
+                             "the loss")
+        loss = closure()
+        self._sync_lr()
+        self._global_step += 1
+        g = self._flat_grad()
+        gnorm = float(jnp.max(jnp.abs(g)))
+        if gnorm <= self._tol_grad:
+            return loss
+        if self._prev_flat_g is not None:
+            s = self._cur_step
+            y = g - self._prev_flat_g
+            ys = float(y @ s)
+            if ys > 1e-10:
+                self._s.append(s)
+                self._y.append(y)
+                if len(self._s) > self._history:
+                    self._s.pop(0)
+                    self._y.pop(0)
+        # two-loop recursion
+        q = g
+        alphas = []
+        for s, y in zip(reversed(self._s), reversed(self._y)):
+            rho = 1.0 / float(y @ s)
+            a = rho * float(s @ q)
+            alphas.append((a, rho, s, y))
+            q = q - a * y
+        if self._s:
+            gamma = float(self._s[-1] @ self._y[-1]) / \
+                float(self._y[-1] @ self._y[-1])
+            q = q * gamma
+        for a, rho, s, y in reversed(alphas):
+            b = rho * float(y @ q)
+            q = q + (a - b) * s
+        lr = float(self._lr_t._data)
+        step_dir = -q
+        self._cur_step = lr * step_dir
+        self._set_flat_params(self._flat_params() + self._cur_step)
+        self._prev_flat_g = g
+        self.clear_grad()
+        return loss

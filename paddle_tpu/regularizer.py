@@ -1,0 +1,34 @@
+"""paddle.regularizer (reference: python/paddle/regularizer.py): weight
+decay attached via ParamAttr/optimizer. The optimizer applies
+`coeff * param` (L2) or `coeff * sign(param)` (L1) to gradients."""
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+        self._regularization_coeff = self.coeff
+
+    def __call__(self, param):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self.coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    """grad += coeff * sign(param)."""
+
+    def __call__(self, param):
+        import paddle_tpu as paddle
+        return paddle.sign(param) * self.coeff
+
+
+class L2Decay(WeightDecayRegularizer):
+    """grad += coeff * param."""
+
+    def __call__(self, param):
+        return param * self.coeff
+
+
+__all__ = ["L1Decay", "L2Decay"]

@@ -191,3 +191,301 @@ class ParallelExecutor:
         raise NotImplementedError(
             "ParallelExecutor is deprecated in the reference; use "
             "paddle_tpu.distributed / jit instead")
+
+
+from paddle_tpu.static import nn  # noqa: E402,F401
+
+# ---------------------------------------------------------------------
+# remaining paddle.static surface (reference: python/paddle/static/
+# {io,param_attr,scope_guard,...})
+# ---------------------------------------------------------------------
+
+
+class Scope:
+    """Variable scope (reference global_scope): name -> Tensor map."""
+
+    def __init__(self):
+        self.vars = {}
+
+    def var(self, name):
+        from paddle_tpu.core.tensor import Tensor
+        if name not in self.vars:
+            self.vars[name] = Tensor(np.zeros((), np.float32))
+        return self.vars[name]
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+
+_global_scope = Scope()
+_scope_stack = []
+
+
+def global_scope():
+    return _scope_stack[-1] if _scope_stack else _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    _scope_stack.append(scope)
+    try:
+        yield
+    finally:
+        _scope_stack.pop()
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """reference append_backward: adds grad ops to the program. In the
+    eager-capture design gradients come from paddle.grad; this returns
+    (param, grad) pairs for API parity."""
+    params = parameter_list
+    if params is None:
+        from paddle_tpu.core.tensor import Parameter
+        params = [v for v in loss._all_leaves()
+                  if isinstance(v, Parameter)] \
+            if hasattr(loss, "_all_leaves") else []
+    grads = paddle.grad(loss, params, retain_graph=True,
+                        allow_unused=True) if params else []
+    return list(zip(params, grads))
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    msg = message or ""
+    arr = np.asarray(input.numpy())
+    print(f"{msg} {'shape=' + str(arr.shape) if print_tensor_shape else ''}"
+          f" {arr.ravel()[:summarize]}")
+    return input
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    return nn.py_func(func, x, out, backward_func,
+                      skip_vars_in_backward_input)
+
+
+class WeightNormParamAttr(paddle.ParamAttr):
+    """Weight-normalized parameter attribute (reference
+    WeightNormParamAttr); the norm reparameterization is applied by
+    nn.utils.weight_norm at layer level."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        super().__init__(name=name, initializer=initializer,
+                         learning_rate=learning_rate,
+                         regularizer=regularizer, trainable=trainable)
+        self.dim = dim
+
+
+class ExponentialMovingAverage:
+    """EMA of trainable parameters (reference static
+    ExponentialMovingAverage): update() after each step; apply()/restore()
+    swap the EMA weights in and out."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self.decay = decay
+        self._ema = {}
+        self._backup = {}
+        self._params = []
+        self._step = 0
+
+    def update(self, parameters=None):
+        from paddle_tpu.core.tensor import Parameter
+        if parameters is None and not self._params:
+            raise ValueError("pass parameters on first update()")
+        if parameters is not None:
+            self._params = list(parameters)
+        self._step += 1
+        d = min(self.decay, (1 + self._step) / (10 + self._step))
+        for p in self._params:
+            key = id(p)
+            if key not in self._ema:
+                self._ema[key] = np.asarray(p.numpy())
+            else:
+                self._ema[key] = d * self._ema[key] \
+                    + (1 - d) * np.asarray(p.numpy())
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        import jax.numpy as jnp
+        for p in self._params:
+            self._backup[id(p)] = np.asarray(p.numpy())
+            p._assign_array(jnp.asarray(self._ema[id(p)]))
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        import jax.numpy as jnp
+        for p in self._params:
+            if id(p) in self._backup:
+                p._assign_array(jnp.asarray(self._backup.pop(id(p))))
+
+
+# --- program serialization (the artifact is pickled state + meta; the
+# compiled form is XLA's job, reference serialize_program/persistables) ---
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    import pickle
+    return pickle.dumps({"feeds": [getattr(v, "name", None)
+                                   for v in _as_list(feed_vars)],
+                         "fetches": len(_as_list(fetch_vars))})
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None, **kwargs):
+    import pickle
+    prog = default_main_program()
+    return pickle.dumps({k: np.asarray(v.numpy())
+                         for k, v in getattr(prog, "_persistables",
+                                             {}).items()})
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data):
+    import pickle
+    meta = pickle.loads(data)
+    p = Program()
+    p._meta = meta
+    return p
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+    vals = pickle.loads(data)
+    program._persistables = {k: paddle.to_tensor(v)
+                             for k, v in vals.items()}
+    return program._persistables
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    return program
+
+
+def load_program_state(model_path, var_list=None):
+    return paddle.load(model_path + ".pdparams") \
+        if not model_path.endswith(".pdparams") else paddle.load(model_path)
+
+
+def set_program_state(program, state_dict):
+    program._persistables = {k: paddle.to_tensor(np.asarray(v))
+                             for k, v in state_dict.items()}
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+# --- places / misc ---
+
+def cpu_places(device_count=None):
+    n = device_count or 1
+    return [paddle.CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    ids = device_ids if device_ids is not None else [0]
+    return [paddle.CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    ids = device_ids if device_ids is not None else [0]
+    return [paddle.XPUPlace(i) for i in ids]
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    t = paddle.full(shape, value, dtype=dtype)
+    t.persistable = persistable
+    if name:
+        t.name = name
+        prog = default_main_program()
+        if not hasattr(prog, "_persistables"):
+            prog._persistables = {}
+        prog._persistables[name] = t
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    return paddle.create_parameter(shape, dtype, name, attr, is_bias,
+                                   default_initializer)
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    topk = paddle.argsort(input, axis=-1, descending=True)[:, :k]
+    lab = paddle.reshape(label, [-1, 1])
+    hit = paddle.sum(paddle.cast(topk == lab, "float32"), axis=1)
+    return paddle.mean(paddle.cast(hit > 0, "float32"))
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    """Batch AUC (reference static auc op) computed host-side."""
+    probs = np.asarray(input.numpy())[:, 1] if input.shape[-1] == 2 \
+        else np.asarray(input.numpy()).ravel()
+    labs = np.asarray(label.numpy()).ravel()
+    order = np.argsort(-probs)
+    labs = labs[order]
+    tps = np.cumsum(labs)
+    fps = np.cumsum(1 - labs)
+    tpr = tps / max(tps[-1], 1)
+    fpr = fps / max(fps[-1], 1)
+    value = float(np.trapz(tpr, fpr))
+    t = paddle.to_tensor(np.asarray(value, np.float32))
+    return t, t, [t]
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """reference device_guard: pins ops to a device inside a program.
+    XLA places the whole computation; we scope paddle.set_device."""
+    prev = paddle.get_device()
+    try:
+        if device:
+            paddle.set_device(device.split(":")[0])
+        yield
+    finally:
+        paddle.set_device(prev)
+
+
+@contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    yield
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    return call_func
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("IPU backend is not supported; this "
+                                  "framework targets TPU via XLA")
+
+
+class IpuStrategy:
+    def __init__(self):
+        self.options = {}
+
+    def set_options(self, opts):
+        self.options.update(opts)
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    a, _, _ = auc(input, label)
+    return a, a, a, a

@@ -1,0 +1,18 @@
+"""paddle.sysconfig (reference: python/paddle/sysconfig.py)."""
+import os
+
+
+def get_include():
+    """Directory of C headers for building extensions against the
+    native runtime (paddle_tpu/native/src)."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "native", "src")
+
+
+def get_lib():
+    """Directory containing the built native runtime library."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "native")
+
+
+__all__ = ["get_include", "get_lib"]

@@ -1,0 +1,11 @@
+"""paddle.tensor namespace (reference: python/paddle/tensor): flat
+re-export of the op library, so `paddle.tensor.math.add` style imports
+work."""
+from paddle_tpu.ops import math, creation, manipulation, logic, search  # noqa: F401
+from paddle_tpu.ops import linalg, random, extra, compat  # noqa: F401
+from paddle_tpu.ops.math import *  # noqa: F401,F403
+from paddle_tpu.ops.creation import *  # noqa: F401,F403
+from paddle_tpu.ops.manipulation import *  # noqa: F401,F403
+from paddle_tpu.ops.logic import *  # noqa: F401,F403
+from paddle_tpu.ops.search import *  # noqa: F401,F403
+from paddle_tpu.core.tensor import Tensor  # noqa: F401

@@ -148,3 +148,91 @@ class DatasetFolder(Dataset):
 
 
 ImageFolder = DatasetFolder
+
+
+class FashionMNIST(MNIST):
+    """Same idx format as MNIST, different files (reference
+    vision/datasets/mnist.py FashionMNIST)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None, root=None):
+        root = root or os.path.expanduser(
+            "~/.cache/paddle_tpu/fashion-mnist")
+        super().__init__(image_path, label_path, mode, transform,
+                         download, backend, root=root)
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers (reference vision/datasets/flowers.py): images
+    in a directory + scipy-format label .mat replaced by a labels.npy,
+    or synthesized per-file labels when absent."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False,
+                 backend=None):
+        root = data_file or os.path.expanduser(
+            "~/.cache/paddle_tpu/flowers")
+        if not os.path.isdir(root):
+            raise FileNotFoundError(
+                f"Flowers data dir not found at {root} "
+                "(no network access; place extracted images there)")
+        self.files = sorted(
+            os.path.join(root, f) for f in os.listdir(root)
+            if f.lower().endswith((".jpg", ".png", ".npy")))
+        lab = label_file or os.path.join(root, "labels.npy")
+        if os.path.exists(lab):
+            self.labels = np.load(lab).astype(np.int64)
+        else:
+            self.labels = np.zeros(len(self.files), np.int64)
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.files)
+
+    def __getitem__(self, idx):
+        path = self.files[idx]
+        if path.endswith(".npy"):
+            img = np.load(path)
+        else:
+            from paddle_tpu.vision import image_load
+            img = image_load(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation pairs (reference
+    vision/datasets/voc2012.py): JPEGImages/ + SegmentationClass/ under
+    `data_file`."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        root = data_file or os.path.expanduser(
+            "~/.cache/paddle_tpu/voc2012")
+        img_dir = os.path.join(root, "JPEGImages")
+        seg_dir = os.path.join(root, "SegmentationClass")
+        if not os.path.isdir(img_dir):
+            raise FileNotFoundError(
+                f"VOC2012 not found at {root} (no network access)")
+        segs = sorted(os.listdir(seg_dir)) if os.path.isdir(seg_dir) \
+            else []
+        self.pairs = []
+        for s in segs:
+            stem = os.path.splitext(s)[0]
+            img = os.path.join(img_dir, stem + ".jpg")
+            if os.path.exists(img):
+                self.pairs.append((img, os.path.join(seg_dir, s)))
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.pairs)
+
+    def __getitem__(self, idx):
+        from paddle_tpu.vision import image_load
+        img_p, seg_p = self.pairs[idx]
+        img = image_load(img_p)
+        seg = image_load(seg_p)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, seg

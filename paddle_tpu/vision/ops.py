@@ -1,0 +1,779 @@
+"""paddle.vision.ops — detection/vision operators (reference:
+python/paddle/vision/ops.py over phi detection kernels).
+
+TPU-native notes: box ops are pure jnp math (XLA fuses them); roi_align /
+roi_pool are gather+interpolate over static grids; nms variants run the
+data-dependent suppression loop as lax.fori over a fixed box budget so the
+whole op stays jittable (the CUDA originals use dynamic work queues)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.dispatch import run_op
+from paddle_tpu.core.tensor import Parameter, Tensor
+
+
+def _t(x):
+    import paddle_tpu as paddle
+    return x if isinstance(x, Tensor) else paddle.to_tensor(x)
+
+
+# ---------------------------------------------------------------------------
+# boxes
+# ---------------------------------------------------------------------------
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against priors (reference box_coder op)."""
+    def f(pb, pbv, tb):
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        px = pb[:, 0] + pw * 0.5
+        py = pb[:, 1] + ph * 0.5
+        if code_type == "encode_center_size":
+            tw = tb[:, None, 2] - tb[:, None, 0] + norm
+            th = tb[:, None, 3] - tb[:, None, 1] + norm
+            tx = tb[:, None, 0] + tw * 0.5
+            ty = tb[:, None, 1] + th * 0.5
+            ox = (tx - px[None]) / pw[None]
+            oy = (ty - py[None]) / ph[None]
+            ow = jnp.log(jnp.abs(tw / pw[None]))
+            oh = jnp.log(jnp.abs(th / ph[None]))
+            out = jnp.stack([ox, oy, ow, oh], -1)
+            if pbv is not None:
+                out = out / pbv[None]
+            return out
+        # decode_center_size
+        if pbv is not None:
+            tb = tb * (pbv[None] if pbv.ndim == 2 else pbv)
+        if axis == 0:
+            px_, py_, pw_, ph_ = (px[None, :], py[None, :],
+                                  pw[None, :], ph[None, :])
+        else:
+            px_, py_, pw_, ph_ = (px[:, None], py[:, None],
+                                  pw[:, None], ph[:, None])
+        ox = tb[..., 0] * pw_ + px_
+        oy = tb[..., 1] * ph_ + py_
+        ow = jnp.exp(tb[..., 2]) * pw_
+        oh = jnp.exp(tb[..., 3]) * ph_
+        return jnp.stack([ox - ow * 0.5, oy - oh * 0.5,
+                          ox + ow * 0.5 - norm,
+                          oy + oh * 0.5 - norm], -1)
+    pbv = _t(prior_box_var) if isinstance(prior_box_var, (Tensor, np.ndarray,
+                                                          list)) else None
+    args = [_t(prior_box)] + ([pbv] if pbv is not None else
+                              [Tensor._wrap(jnp.ones((1, 4)))]) \
+        + [_t(target_box)]
+    if pbv is None:
+        def g(pb, _unused, tb):
+            return f(pb, None, tb)
+        return run_op("box_coder", g, *args)
+    return run_op("box_coder", f, *args)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior boxes over the feature map grid (reference prior_box)."""
+    feat = _t(input)
+    img = _t(image)
+    fh, fw = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    ars = list(aspect_ratios)
+    if flip:
+        ars = ars + [1.0 / a for a in aspect_ratios if a != 1.0]
+    boxes = []
+    for s in min_sizes:
+        boxes.append((s, s))
+        if max_sizes:
+            for ms in max_sizes:
+                boxes.append((np.sqrt(s * ms), np.sqrt(s * ms)))
+        for a in ars:
+            if abs(a - 1.0) < 1e-6:
+                continue
+            boxes.append((s * np.sqrt(a), s / np.sqrt(a)))
+    num_priors = len(boxes)
+
+    def f(_feat, _img):
+        cx = (jnp.arange(fw) + offset) * step_w
+        cy = (jnp.arange(fh) + offset) * step_h
+        cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")
+        out = []
+        for bw, bh in boxes:
+            out.append(jnp.stack([(cxg - bw / 2) / iw, (cyg - bh / 2) / ih,
+                                  (cxg + bw / 2) / iw, (cyg + bh / 2) / ih],
+                                 -1))
+        b = jnp.stack(out, 2)          # [H, W, P, 4]
+        if clip:
+            b = jnp.clip(b, 0.0, 1.0)
+        var = jnp.broadcast_to(jnp.asarray(variance, b.dtype),
+                               b.shape)
+        return b, var
+    return run_op("prior_box", f, feat, img, n_outputs=2,
+                  differentiable=False)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """Decode YOLOv3 head output to boxes+scores (reference yolo_box)."""
+    na = len(anchors) // 2
+    anc = np.asarray(anchors, np.float32).reshape(na, 2)
+
+    def f(xa, imgs):
+        n, c, h, w = xa.shape
+        xa = xa.reshape(n, na, -1, h, w)
+        grid_x = jnp.arange(w, dtype=xa.dtype)
+        grid_y = jnp.arange(h, dtype=xa.dtype)
+        gx, gy = jnp.meshgrid(grid_x, grid_y, indexing="xy")
+        bx = (jax.nn.sigmoid(xa[:, :, 0]) * scale_x_y
+              - (scale_x_y - 1) / 2 + gx) / w
+        by = (jax.nn.sigmoid(xa[:, :, 1]) * scale_x_y
+              - (scale_x_y - 1) / 2 + gy) / h
+        in_w = downsample_ratio * w
+        in_h = downsample_ratio * h
+        bw = jnp.exp(xa[:, :, 2]) * anc[None, :, 0, None, None] / in_w
+        bh = jnp.exp(xa[:, :, 3]) * anc[None, :, 1, None, None] / in_h
+        obj = jax.nn.sigmoid(xa[:, :, 4])
+        cls = jax.nn.sigmoid(xa[:, :, 5:5 + class_num])
+        score = obj[:, :, None] * cls
+        ih = imgs[:, 0].astype(xa.dtype)
+        iw = imgs[:, 1].astype(xa.dtype)
+        x0 = (bx - bw / 2) * iw[:, None, None, None]
+        y0 = (by - bh / 2) * ih[:, None, None, None]
+        x1 = (bx + bw / 2) * iw[:, None, None, None]
+        y1 = (by + bh / 2) * ih[:, None, None, None]
+        if clip_bbox:
+            x0 = jnp.clip(x0, 0)
+            y0 = jnp.clip(y0, 0)
+            x1 = jnp.minimum(x1, iw[:, None, None, None] - 1)
+            y1 = jnp.minimum(y1, ih[:, None, None, None] - 1)
+        boxes = jnp.stack([x0, y0, x1, y1], -1).reshape(n, -1, 4)
+        mask = obj.reshape(n, -1) > conf_thresh
+        boxes = jnp.where(mask[..., None], boxes, 0.0)
+        scores = score.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num)
+        scores = jnp.where(mask[..., None], scores, 0.0)
+        return boxes, scores
+    return run_op("yolo_box", f, _t(x), _t(img_size), n_outputs=2,
+                  differentiable=False)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, scale_x_y=1.0, name=None):
+    """YOLOv3 training loss (reference yolo_loss kernel). Simplified
+    dense-assignment variant: each gt is matched to its best anchor on its
+    grid cell; objectness BCE everywhere else with ignore region."""
+    na = len(anchor_mask)
+    anc = np.asarray(anchors, np.float32).reshape(-1, 2)
+    anc_m = anc[np.asarray(anchor_mask)]
+
+    def f(xa, gb, gl):
+        n, c, h, w = xa.shape
+        xa = xa.reshape(n, na, 5 + class_num, h, w)
+        in_w = downsample_ratio * w
+        tx = jax.nn.sigmoid(xa[:, :, 0])
+        ty = jax.nn.sigmoid(xa[:, :, 1])
+        obj = xa[:, :, 4]
+        # build targets densely
+        gx = gb[..., 0] * w
+        gy = gb[..., 1] * h
+        gw = gb[..., 2]
+        gh = gb[..., 3]
+        valid = (gw > 0) & (gh > 0)
+        # anchor match by IoU of (w,h)
+        aw = anc_m[:, 0] / in_w
+        ah = anc_m[:, 1] / in_w
+        inter = jnp.minimum(gw[..., None], aw) * \
+            jnp.minimum(gh[..., None], ah)
+        union = gw[..., None] * gh[..., None] + aw * ah - inter
+        best = jnp.argmax(inter / (union + 1e-9), -1)   # [N, B]
+        ci = jnp.clip(gx.astype(jnp.int32), 0, w - 1)
+        cj = jnp.clip(gy.astype(jnp.int32), 0, h - 1)
+        # objectness target map
+        tobj = jnp.zeros((n, na, h, w))
+        bidx = jnp.arange(n)[:, None]
+        tobj = tobj.at[bidx, best, cj, ci].max(valid.astype(tobj.dtype))
+        obj_loss = jnp.maximum(obj, 0) - obj * tobj + \
+            jnp.log1p(jnp.exp(-jnp.abs(obj)))
+        # coordinate loss at assigned cells
+        px = tx[bidx, best, cj, ci]
+        py = ty[bidx, best, cj, ci]
+        lx = (px - (gx - jnp.floor(gx))) ** 2
+        ly = (py - (gy - jnp.floor(gy))) ** 2
+        coord = jnp.sum((lx + ly) * valid, -1)
+        cls_logits = xa[:, :, 5:]
+        tcls = jax.nn.one_hot(gl, class_num)
+        pc = cls_logits[bidx, best, :, cj, ci]
+        cls_loss = jnp.sum(jnp.sum(
+            (jnp.maximum(pc, 0) - pc * tcls
+             + jnp.log1p(jnp.exp(-jnp.abs(pc)))), -1) * valid, -1)
+        return jnp.sum(obj_loss, (1, 2, 3)) + coord + cls_loss
+    return run_op("yolo_loss", f, _t(x), _t(gt_box), _t(gt_label))
+
+
+# ---------------------------------------------------------------------------
+# RoI ops
+# ---------------------------------------------------------------------------
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (reference roi_align kernel): bilinear sampling over a
+    static grid per output cell — a gather, XLA-friendly."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+
+    def f(feat, bxs, bn):
+        n, c, h, w = feat.shape
+        nb = bxs.shape[0]
+        # map each box to its batch image
+        img_idx = jnp.repeat(jnp.arange(bn.shape[0]), nb // bn.shape[0]) \
+            if False else jnp.cumsum(
+            jnp.zeros(nb, jnp.int32).at[jnp.cumsum(bn)[:-1]].add(1))
+        off = 0.5 if aligned else 0.0
+        x0 = bxs[:, 0] * spatial_scale - off
+        y0 = bxs[:, 1] * spatial_scale - off
+        x1 = bxs[:, 2] * spatial_scale - off
+        y1 = bxs[:, 3] * spatial_scale - off
+        bw = x1 - x0
+        bh = y1 - y0
+        if not aligned:
+            bw = jnp.maximum(bw, 1.0)
+            bh = jnp.maximum(bh, 1.0)
+        # sample grid [nb, oh*sr, ow*sr]
+        gy = y0[:, None] + (jnp.arange(oh * sr) + 0.5)[None] * \
+            (bh[:, None] / (oh * sr))
+        gx = x0[:, None] + (jnp.arange(ow * sr) + 0.5)[None] * \
+            (bw[:, None] / (ow * sr))
+
+        def bilinear(iy, ix):
+            yy0 = jnp.clip(jnp.floor(iy), 0, h - 1)
+            xx0 = jnp.clip(jnp.floor(ix), 0, w - 1)
+            yy1 = jnp.clip(yy0 + 1, 0, h - 1)
+            xx1 = jnp.clip(xx0 + 1, 0, w - 1)
+            ly = iy - yy0
+            lx = ix - xx0
+            ly = jnp.clip(ly, 0, 1)
+            lx = jnp.clip(lx, 0, 1)
+
+            def gather(yy, xx):
+                # feat[img, :, yy, xx] for per-box yy [nb,H'] xx [nb,W']
+                fy = feat[img_idx]          # [nb, c, h, w]
+                out = fy[jnp.arange(nb)[:, None, None], :,
+                         yy[:, :, None].astype(jnp.int32),
+                         xx[:, None, :].astype(jnp.int32)]
+                return out                  # [nb, H', W', c]
+            v = (gather(yy0, xx0) * ((1 - ly)[:, :, None, None]
+                                     * (1 - lx)[:, None, :, None])
+                 + gather(yy1, xx0) * (ly[:, :, None, None]
+                                       * (1 - lx)[:, None, :, None])
+                 + gather(yy0, xx1) * ((1 - ly)[:, :, None, None]
+                                       * lx[:, None, :, None])
+                 + gather(yy1, xx1) * (ly[:, :, None, None]
+                                       * lx[:, None, :, None]))
+            return v                        # [nb, H', W', c]
+        samples = bilinear(gy, gx)          # [nb, oh*sr, ow*sr, c]
+        samples = samples.reshape(nb, oh, sr, ow, sr, -1)
+        out = samples.mean((2, 4))          # [nb, oh, ow, c]
+        return jnp.moveaxis(out, -1, 1)
+    return run_op("roi_align", f, _t(x), _t(boxes), _t(boxes_num))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """RoIPool (reference roi_pool): adaptive max pool per box, computed
+    via a dense sample grid (8 samples/cell) + max."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    sr = 4
+
+    def f(feat, bxs, bn):
+        n, c, h, w = feat.shape
+        nb = bxs.shape[0]
+        img_idx = jnp.cumsum(
+            jnp.zeros(nb, jnp.int32).at[jnp.cumsum(bn)[:-1]].add(1))
+        x0 = jnp.round(bxs[:, 0] * spatial_scale)
+        y0 = jnp.round(bxs[:, 1] * spatial_scale)
+        x1 = jnp.round(bxs[:, 2] * spatial_scale)
+        y1 = jnp.round(bxs[:, 3] * spatial_scale)
+        bw = jnp.maximum(x1 - x0 + 1, 1.0)
+        bh = jnp.maximum(y1 - y0 + 1, 1.0)
+        gy = y0[:, None] + (jnp.arange(oh * sr) + 0.5)[None] * \
+            (bh[:, None] / (oh * sr))
+        gx = x0[:, None] + (jnp.arange(ow * sr) + 0.5)[None] * \
+            (bw[:, None] / (ow * sr))
+        yy = jnp.clip(gy, 0, h - 1).astype(jnp.int32)
+        xx = jnp.clip(gx, 0, w - 1).astype(jnp.int32)
+        fy = feat[img_idx]
+        out = fy[jnp.arange(nb)[:, None, None], :,
+                 yy[:, :, None], xx[:, None, :]]   # [nb, H', W', c]
+        out = out.reshape(nb, oh, sr, ow, sr, -1).max((2, 4))
+        return jnp.moveaxis(out, -1, 1)
+    return run_op("roi_pool", f, _t(x), _t(boxes), _t(boxes_num))
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pool (reference psroi_pool): channel k of
+    output cell (i,j) pools from input channel group (i*ow+j)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def f(feat, bxs, bn):
+        n, c, h, w = feat.shape
+        co = c // (oh * ow)
+        nb = bxs.shape[0]
+        img_idx = jnp.cumsum(
+            jnp.zeros(nb, jnp.int32).at[jnp.cumsum(bn)[:-1]].add(1))
+        # average pool each cell from its group channels
+        x0 = bxs[:, 0] * spatial_scale
+        y0 = bxs[:, 1] * spatial_scale
+        bw = jnp.maximum((bxs[:, 2] - bxs[:, 0]) * spatial_scale, 0.1)
+        bh = jnp.maximum((bxs[:, 3] - bxs[:, 1]) * spatial_scale, 0.1)
+        sr = 4
+        gy = y0[:, None] + (jnp.arange(oh * sr) + 0.5)[None] * \
+            (bh[:, None] / (oh * sr))
+        gx = x0[:, None] + (jnp.arange(ow * sr) + 0.5)[None] * \
+            (bw[:, None] / (ow * sr))
+        yy = jnp.clip(gy, 0, h - 1).astype(jnp.int32)
+        xx = jnp.clip(gx, 0, w - 1).astype(jnp.int32)
+        fy = feat[img_idx]                  # [nb, c, h, w]
+        out = fy[jnp.arange(nb)[:, None, None], :,
+                 yy[:, :, None], xx[:, None, :]]   # [nb, H', W', c]
+        out = out.reshape(nb, oh, sr, ow, sr, c).mean((2, 4))
+        # [nb, oh, ow, c] -> pick group channels
+        out = out.reshape(nb, oh, ow, oh * ow, co)
+        cell = (jnp.arange(oh)[:, None] * ow
+                + jnp.arange(ow)[None, :])  # [oh, ow]
+        picked = jnp.take_along_axis(
+            out, cell[None, :, :, None, None], 3)[..., 0, :]
+        return jnp.moveaxis(picked, -1, 1)
+    return run_op("psroi_pool", f, _t(x), _t(boxes), _t(boxes_num))
+
+
+# ---------------------------------------------------------------------------
+# NMS family
+# ---------------------------------------------------------------------------
+
+def _iou_matrix(boxes):
+    x0, y0, x1, y1 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = jnp.maximum(x1 - x0, 0) * jnp.maximum(y1 - y0, 0)
+    ix0 = jnp.maximum(x0[:, None], x0[None, :])
+    iy0 = jnp.maximum(y0[:, None], y0[None, :])
+    ix1 = jnp.minimum(x1[:, None], x1[None, :])
+    iy1 = jnp.minimum(y1[:, None], y1[None, :])
+    inter = jnp.maximum(ix1 - ix0, 0) * jnp.maximum(iy1 - iy0, 0)
+    return inter / jnp.maximum(area[:, None] + area[None, :] - inter, 1e-9)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Hard NMS (reference nms op). Greedy suppression as a fori_loop over
+    the score-ordered box list — static shapes, jittable."""
+    b = _t(boxes)
+    n = b.shape[0]
+
+    def f(bx, *rest):
+        sc = rest[0] if rest else jnp.arange(n, 0, -1).astype(bx.dtype)
+        order = jnp.argsort(-sc)
+        bs = bx[order]
+        iou = _iou_matrix(bs)
+        if categories is not None and rest[1:]:
+            cat = rest[1][order]
+            iou = jnp.where(cat[:, None] == cat[None, :], iou, 0.0)
+
+        def body(i, keep):
+            # suppress if overlaps any earlier kept box
+            over = (iou[i] > iou_threshold) & (jnp.arange(n) < i) & keep
+            return keep.at[i].set(~jnp.any(over))
+        keep = lax.fori_loop(1, n, body, jnp.ones(n, bool))
+        # kept boxes first (score order), suppressed after — the host
+        # slices the first `count` entries for the dynamic-length result
+        rank = jnp.where(keep, jnp.arange(n), n + jnp.arange(n))
+        perm = jnp.argsort(rank)
+        return order[perm], keep.sum()
+    args = [b] + ([_t(scores)] if scores is not None else []) \
+        + ([_t(category_idxs)] if category_idxs is not None else [])
+    idx, count = run_op("nms", f, *args, n_outputs=2,
+                        differentiable=False)
+    k = int(count.numpy())
+    out = idx[:k]
+    if top_k is not None:
+        out = out[:min(top_k, k)]
+    return out
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold,
+               nms_top_k, keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2) — decay-based soft suppression; fully parallel,
+    the idiomatic TPU NMS (reference matrix_nms op)."""
+    def f(bx, sc):
+        n, cls, _ = bx.shape if bx.ndim == 3 else (1,) + bx.shape
+        bb = bx if bx.ndim == 3 else bx[None]
+        ss = sc if sc.ndim == 3 else sc[None]
+        outs = []
+        for b_i in range(bb.shape[0]):
+            per_cls = []
+            for c_i in range(ss.shape[1]):
+                if c_i == background_label:
+                    continue
+                s = ss[b_i, c_i]
+                boxes_c = bb[b_i]
+                order = jnp.argsort(-s)[:nms_top_k]
+                s_o = s[order]
+                b_o = boxes_c[order]
+                iou = _iou_matrix(b_o)
+                upper = jnp.triu(iou, 1)
+                # decay per box: prod over higher-scored boxes
+                max_iou = jnp.max(upper, 0)
+                if use_gaussian:
+                    decay = jnp.exp(-(upper ** 2 - max_iou[None] ** 2)
+                                    / gaussian_sigma)
+                    decay = jnp.min(jnp.where(upper > 0, decay, 1.0), 0)
+                else:
+                    decay = jnp.min(jnp.where(
+                        upper > 0,
+                        (1 - upper) / jnp.maximum(1 - max_iou[None], 1e-9),
+                        1.0), 0)
+                s_new = s_o * decay
+                keep = s_new > post_threshold
+                cls_col = jnp.full_like(s_new, c_i)
+                entry = jnp.concatenate(
+                    [cls_col[:, None], s_new[:, None], b_o], -1)
+                entry = jnp.where(keep[:, None], entry, -1.0)
+                per_cls.append(entry)
+            cat = jnp.concatenate(per_cls, 0)
+            order = jnp.argsort(-cat[:, 1])[:keep_top_k]
+            outs.append(cat[order])
+        return jnp.concatenate(outs, 0)
+    out = run_op("matrix_nms", f, _t(bboxes), _t(scores),
+                 differentiable=False)
+    arr = out.numpy()
+    valid = arr[:, 1] > 0
+    import paddle_tpu as paddle
+    kept = paddle.to_tensor(arr[valid])
+    rois_num = paddle.to_tensor(np.asarray([int(valid.sum())], np.int32))
+    if return_index:
+        idx = paddle.to_tensor(np.nonzero(valid)[0].astype(np.int32))
+        return (kept, idx, rois_num) if return_rois_num else (kept, idx)
+    return (kept, rois_num) if return_rois_num else kept
+
+
+# ---------------------------------------------------------------------------
+# deformable conv
+# ---------------------------------------------------------------------------
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (reference deform_conv2d): bilinear-sample
+    the input at offset positions per kernel tap, then a dense matmul —
+    gather + GEMM on the MXU instead of the CUDA scatter kernel."""
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+
+    def f(xa, off, w, *rest):
+        n, cin, h, wdt = xa.shape
+        cout, cin_g, kh, kw = w.shape
+        oh = (h + 2 * pd[0] - dl[0] * (kh - 1) - 1) // st[0] + 1
+        ow = (wdt + 2 * pd[1] - dl[1] * (kw - 1) - 1) // st[1] + 1
+        # base sampling positions per tap
+        ys = jnp.arange(oh) * st[0] - pd[0]
+        xs = jnp.arange(ow) * st[1] - pd[1]
+        ky = jnp.arange(kh) * dl[0]
+        kx = jnp.arange(kw) * dl[1]
+        base_y = ys[:, None, None, None] + ky[None, None, :, None]
+        base_x = xs[None, :, None, None] + kx[None, None, None, :]
+        # offsets [N, 2*dg*kh*kw, oh, ow] -> [N, dg, kh, kw, 2, oh, ow]
+        off = off.reshape(n, deformable_groups, kh * kw, 2, oh, ow)
+        oy = off[:, :, :, 0].reshape(n, deformable_groups, kh, kw, oh, ow)
+        ox = off[:, :, :, 1].reshape(n, deformable_groups, kh, kw, oh, ow)
+        # full sampling coordinate per (tap, out position):
+        # base [oh, ow, kh, kw] -> [1, 1, kh, kw, oh, ow]
+        py = base_y.transpose(2, 3, 0, 1)[None, None] + oy
+        px = base_x.transpose(2, 3, 0, 1)[None, None] + ox
+
+        def sample(iy, ix):
+            y0 = jnp.floor(iy)
+            x0 = jnp.floor(ix)
+            wy = iy - y0
+            wx = ix - x0
+            out = 0
+            for (yy, ww_y) in ((y0, 1 - wy), (y0 + 1, wy)):
+                for (xx, ww_x) in ((x0, 1 - wx), (x0 + 1, wx)):
+                    valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < wdt)
+                    yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+                    xc = jnp.clip(xx, 0, wdt - 1).astype(jnp.int32)
+                    # gather per dg group; broadcast channels within group
+                    # xa: [n, cin, h, w]; yc/xc: [n, dg, kh, kw, oh, ow]
+                    cg = cin // deformable_groups
+                    xg = xa.reshape(n, deformable_groups, cg, h, wdt)
+                    g = xg[jnp.arange(n)[:, None, None, None, None, None],
+                           jnp.arange(deformable_groups)[None, :, None,
+                                                         None, None, None],
+                           :, yc, xc]
+                    # g: [n, dg, kh, kw, oh, ow, cg]
+                    wgt = (ww_y * ww_x * valid)[..., None]
+                    out = out + g * wgt
+            return out                      # [n, dg, kh, kw, oh, ow, cg]
+        cols = sample(py, px)
+        if rest:  # modulation mask (v2)
+            m = rest[0].reshape(n, deformable_groups, kh, kw, oh, ow)
+            cols = cols * m[..., None]
+        # [n, dg, kh, kw, oh, ow, cg] -> [n, cin*kh*kw, oh*ow]
+        cols = cols.transpose(0, 1, 6, 2, 3, 4, 5).reshape(
+            n, cin, kh, kw, oh, ow)
+        cols2 = cols.reshape(n, cin * kh * kw, oh * ow)
+        wmat = w.reshape(cout, cin_g * kh * kw)
+        if groups == 1:
+            out = jnp.einsum("ok,nkp->nop", wmat, cols2)
+        else:
+            cols_g = cols2.reshape(n, groups, (cin // groups) * kh * kw, -1)
+            wg = wmat.reshape(groups, cout // groups, -1)
+            out = jnp.einsum("gok,ngkp->ngop", wg, cols_g).reshape(
+                n, cout, -1)
+        out = out.reshape(n, cout, oh, ow)
+        if len(rest) > 1:
+            out = out + rest[1].reshape(1, -1, 1, 1)
+        return out
+    args = [_t(x), _t(offset), _t(weight)]
+    if mask is not None:
+        args.append(_t(mask))
+    if bias is not None:
+        if mask is None:
+            # keep positional layout: mask slot then bias
+            args.append(Tensor._wrap(jnp.ones(
+                (int(_t(x).shape[0]), deformable_groups
+                 * int(_t(weight).shape[2]) * int(_t(weight).shape[3]),
+                 1, 1))))
+        args.append(_t(bias))
+    return run_op("deform_conv2d", f, *args)
+
+
+class DeformConv2D:
+    """Layer wrapper over deform_conv2d (reference vision/ops.py
+    DeformConv2D)."""
+
+    def __new__(cls, *args, **kwargs):
+        from paddle_tpu.nn.layer.layers import Layer
+
+        class _DeformConv2D(Layer):
+            def __init__(self, in_channels, out_channels, kernel_size,
+                         stride=1, padding=0, dilation=1,
+                         deformable_groups=1, groups=1, weight_attr=None,
+                         bias_attr=None):
+                super().__init__()
+                ks = (kernel_size, kernel_size) \
+                    if isinstance(kernel_size, int) else tuple(kernel_size)
+                rng = np.random.RandomState(0)
+                bound = 1.0 / np.sqrt(in_channels * ks[0] * ks[1])
+                self.weight = Parameter(rng.uniform(
+                    -bound, bound,
+                    (out_channels, in_channels // groups) + ks
+                ).astype(np.float32))
+                self.bias = None if bias_attr is False else Parameter(
+                    np.zeros(out_channels, np.float32))
+                self._cfg = (stride, padding, dilation, deformable_groups,
+                             groups)
+
+            def forward(self, x, offset, mask=None):
+                s, p, d, dg, g = self._cfg
+                return deform_conv2d(x, offset, self.weight, self.bias,
+                                     s, p, d, dg, g, mask)
+        obj = _DeformConv2D(*args, **kwargs)
+        return obj
+
+
+# ---------------------------------------------------------------------------
+# proposals
+# ---------------------------------------------------------------------------
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (reference
+    distribute_fpn_proposals)."""
+    import paddle_tpu as paddle
+    rois = _t(fpn_rois)
+    arr = np.asarray(rois.numpy())
+    off = 1.0 if pixel_offset else 0.0
+    scale = np.sqrt(np.maximum(
+        (arr[:, 2] - arr[:, 0] + off) * (arr[:, 3] - arr[:, 1] + off), 0))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs = []
+    restore = np.zeros(len(arr), np.int32)
+    pos = 0
+    idx_all = []
+    for L in range(min_level, max_level + 1):
+        sel = np.nonzero(lvl == L)[0]
+        outs.append(paddle.to_tensor(arr[sel].reshape(-1, 4)))
+        idx_all.append(sel)
+        restore[sel] = np.arange(pos, pos + len(sel))
+        pos += len(sel)
+    restore_ind = paddle.to_tensor(
+        np.argsort(np.concatenate(idx_all)).astype(np.int32).reshape(-1, 1))
+    if rois_num is not None:
+        rois_num_per_level = [
+            paddle.to_tensor(np.asarray([len(i)], np.int32))
+            for i in idx_all]
+        return outs, restore_ind, rois_num_per_level
+    return outs, restore_ind
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation (reference generate_proposals): decode
+    anchors, clip, filter small, NMS."""
+    import paddle_tpu as paddle
+    sc = np.asarray(_t(scores).numpy())       # [N, A, H, W]
+    bd = np.asarray(_t(bbox_deltas).numpy())  # [N, 4A, H, W]
+    ims = np.asarray(_t(img_size).numpy())    # [N, 2]
+    anc = np.asarray(_t(anchors).numpy()).reshape(-1, 4)
+    var = np.asarray(_t(variances).numpy()).reshape(-1, 4)
+    n = sc.shape[0]
+    all_rois, all_nums = [], []
+    for i in range(n):
+        s = sc[i].transpose(1, 2, 0).reshape(-1)
+        d = bd[i].reshape(-1, 4, sc.shape[2], sc.shape[3]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], anc[order % len(anc)], \
+            var[order % len(var)]
+        aw = a[:, 2] - a[:, 0]
+        ah = a[:, 3] - a[:, 1]
+        ax = a[:, 0] + aw / 2
+        ay = a[:, 1] + ah / 2
+        cx = v[:, 0] * d[:, 0] * aw + ax
+        cy = v[:, 1] * d[:, 1] * ah + ay
+        w = np.exp(np.minimum(v[:, 2] * d[:, 2], 10)) * aw
+        h = np.exp(np.minimum(v[:, 3] * d[:, 3], 10)) * ah
+        boxes = np.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                         -1)
+        ih, iw = ims[i]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - 1)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - 1)
+        keep = ((boxes[:, 2] - boxes[:, 0]) >= min_size) & \
+               ((boxes[:, 3] - boxes[:, 1]) >= min_size)
+        boxes, s = boxes[keep], s[keep]
+        if len(boxes):
+            kept = nms(paddle.to_tensor(boxes.astype(np.float32)),
+                       nms_thresh,
+                       paddle.to_tensor(s.astype(np.float32)))
+            ki = np.asarray(kept.numpy())[:post_nms_top_n]
+            boxes = boxes[ki]
+        all_rois.append(boxes.astype(np.float32))
+        all_nums.append(len(boxes))
+    rois = paddle.to_tensor(np.concatenate(all_rois, 0)
+                            if all_rois else np.zeros((0, 4), np.float32))
+    scores_out = paddle.to_tensor(
+        np.concatenate([np.zeros(k, np.float32) for k in all_nums])
+        if all_nums else np.zeros((0,), np.float32))
+    if return_rois_num:
+        return rois, scores_out, paddle.to_tensor(
+            np.asarray(all_nums, np.int32))
+    return rois, scores_out
+
+
+# ---------------------------------------------------------------------------
+# image IO
+# ---------------------------------------------------------------------------
+
+def read_file(filename, name=None):
+    import paddle_tpu as paddle
+    with open(filename, "rb") as fh:
+        data = np.frombuffer(fh.read(), np.uint8)
+    return paddle.to_tensor(data)
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor. Uses Pillow on host (the reference uses
+    nvjpeg on device; TPU has no on-device decode — host decode + transfer
+    is the idiomatic path, usually hidden in the input pipeline)."""
+    import io
+    import paddle_tpu as paddle
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError("decode_jpeg requires Pillow") from e
+    data = bytes(np.asarray(_t(x).numpy(), np.uint8))
+    img = Image.open(io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode in ("rgb", "unchanged"):
+        img = img.convert("RGB") if mode == "rgb" else img
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return paddle.to_tensor(arr)
+
+
+class RoIPool:
+    def __new__(cls, output_size, spatial_scale=1.0):
+        from paddle_tpu.nn.layer.layers import Layer
+
+        class _RoIPool(Layer):
+            def __init__(self):
+                super().__init__()
+
+            def forward(self, x, boxes, boxes_num):
+                return roi_pool(x, boxes, boxes_num, output_size,
+                                spatial_scale)
+        return _RoIPool()
+
+
+class RoIAlign:
+    def __new__(cls, output_size, spatial_scale=1.0):
+        from paddle_tpu.nn.layer.layers import Layer
+
+        class _RoIAlign(Layer):
+            def __init__(self):
+                super().__init__()
+
+            def forward(self, x, boxes, boxes_num, aligned=True):
+                return roi_align(x, boxes, boxes_num, output_size,
+                                 spatial_scale, aligned=aligned)
+        return _RoIAlign()
+
+
+class PSRoIPool:
+    def __new__(cls, output_size, spatial_scale=1.0):
+        from paddle_tpu.nn.layer.layers import Layer
+
+        class _PSRoIPool(Layer):
+            def __init__(self):
+                super().__init__()
+
+            def forward(self, x, boxes, boxes_num):
+                return psroi_pool(x, boxes, boxes_num, output_size,
+                                  spatial_scale)
+        return _PSRoIPool()
+
+
+__all__ = [
+    "yolo_loss", "yolo_box", "prior_box", "box_coder", "deform_conv2d",
+    "DeformConv2D", "distribute_fpn_proposals", "generate_proposals",
+    "read_file", "decode_jpeg", "roi_pool", "RoIPool", "psroi_pool",
+    "PSRoIPool", "roi_align", "RoIAlign", "nms", "matrix_nms",
+]
