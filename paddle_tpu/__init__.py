@@ -79,7 +79,9 @@ import paddle_tpu.linalg as linalg  # noqa: F401
 _LAZY = {"vision", "hapi", "profiler", "static", "models", "parallel",
          "incubate", "distribution", "sparse", "device", "inference",
          "quantization", "utils", "text", "geometric", "audio",
-         "regularizer", "sysconfig", "hub", "onnx", "tensor", "base"}
+         "regularizer", "sysconfig", "hub", "onnx", "tensor", "base",
+         "callbacks", "dataset", "reader", "decomposition", "pir_utils",
+         "batch"}
 import paddle_tpu.fft as fft  # noqa: F401
 import paddle_tpu.signal as signal  # noqa: F401
 
@@ -97,7 +99,10 @@ del _biv
 def __getattr__(name):
     if name in _LAZY:
         import importlib
-        return importlib.import_module(f"paddle_tpu.{name}")
+        mod = importlib.import_module(f"paddle_tpu.{name}")
+        if name == "batch":
+            return mod.batch      # paddle.batch is the function itself
+        return mod
     if name == "Model":
         from paddle_tpu.hapi import Model
         return Model
@@ -105,6 +110,11 @@ def __getattr__(name):
         from paddle_tpu.distributed.parallel import DataParallel
         return DataParallel
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list(_LAZY) +
+                      ["Model", "DataParallel"]))
 
 
 __version__ = "0.1.0"

@@ -16,6 +16,7 @@ from .tape import Edge, GradNode, run_backward
 __all__ = [
     "backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
     "is_grad_enabled", "PyLayer", "PyLayerContext", "jacobian", "hessian",
+    "saved_tensors_hooks",
 ]
 
 
@@ -102,21 +103,57 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
 # PyLayer: user-defined autograd function (reference eager/pylayer +
 # fluid/pybind/eager_py_layer.cc)
 # --------------------------------------------------------------------------
+#: active (pack, unpack) hook pairs for tensors saved for backward
+#: (reference autograd/saved_tensors_hooks — TensorWrapper pack/unpack
+#: hooks; here they intercept PyLayer save_for_backward captures)
+_saved_tensors_hooks = []
+
+
+class saved_tensors_hooks:
+    """Context manager: pack_hook(tensor) runs when a tensor is saved
+    for backward, unpack_hook(packed) when it is retrieved — the
+    CPU-offload / recompute seam (reference
+    python/paddle/autograd/saved_tensors_hooks.py)."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        _saved_tensors_hooks.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        _saved_tensors_hooks.pop()
+        return False
+
+
 class PyLayerContext:
     def __init__(self):
         self._saved = ()
+        self._unpack = None
         self.materialize_grads = True
         self._non_differentiable = ()
 
     def save_for_backward(self, *tensors):
-        self._saved = tensors
+        if _saved_tensors_hooks:
+            pack, unpack = _saved_tensors_hooks[-1]
+            self._saved = tuple(pack(t) for t in tensors)
+            self._unpack = unpack
+        else:
+            self._saved = tensors
+
+    def _unpacked(self):
+        if self._unpack is not None:
+            return tuple(self._unpack(p) for p in self._saved)
+        return self._saved
 
     @property
     def saved_tensor(self):
-        return self._saved
+        return self._unpacked()
 
     def saved_tensors(self):
-        return self._saved
+        return self._unpacked()
 
     def mark_non_differentiable(self, *tensors):
         self._non_differentiable = tensors

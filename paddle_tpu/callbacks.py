@@ -1,0 +1,11 @@
+"""paddle.callbacks namespace (reference: python/paddle/callbacks.py —
+re-export of the hapi callback family)."""
+from paddle_tpu.hapi import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+    ReduceLROnPlateau, VisualDL, WandbCallback,
+)
+
+__all__ = [
+    'Callback', 'ProgBarLogger', 'ModelCheckpoint', 'VisualDL',
+    'LRScheduler', 'EarlyStopping', 'ReduceLROnPlateau', 'WandbCallback',
+]

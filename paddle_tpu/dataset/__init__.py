@@ -1,0 +1,22 @@
+"""paddle.dataset equivalent (reference: python/paddle/dataset/) —
+legacy reader-style dataset loaders. The reference downloads archives;
+this environment has no egress, so each loader reads a local copy when
+present (same cache layout, ``~/.cache/paddle/dataset``) and otherwise
+falls back to a small deterministic synthetic sample with the exact
+item shapes/dtypes of the original, keeping reader-API consumers
+runnable end to end."""
+from . import common  # noqa: F401
+from . import mnist  # noqa: F401
+from . import cifar  # noqa: F401
+from . import uci_housing  # noqa: F401
+from . import imdb  # noqa: F401
+from . import imikolov  # noqa: F401
+from . import movielens  # noqa: F401
+from . import conll05  # noqa: F401
+from . import flowers  # noqa: F401
+from . import voc2012  # noqa: F401
+from . import wmt14  # noqa: F401
+from . import wmt16  # noqa: F401
+from . import image  # noqa: F401
+
+__all__ = []

@@ -1,0 +1,20 @@
+"""paddle.decomposition equivalent (reference: python/paddle/decomposition
+— decompose() rewrites composite PIR ops into the ~primitive set for
+higher-order AD and the compiler).
+
+TPU-native framing: XLA itself decomposes composite HLO into primitive
+HLO, and jax.vjp/jvp already differentiate through every primitive, so
+the *execution* need the reference serves is absorbed by the compiler.
+What this package keeps is the API surface and an inspectable rule
+registry: python decomposition rules for composite ops (softmax,
+layer_norm, gelu, ...) expressed over primitive jnp ops, usable to
+lower a captured program to primitives explicitly (e.g. for
+quantization passes or custom-vjp analysis)."""
+from .register import register_decomp, get_decomp_rule, has_decomp_rule
+from . import rules  # noqa: F401  (populates the registry)
+from .decomp import decompose, prim_guard, enable_prim, prim_enabled
+
+__all__ = [
+    "decompose", "register_decomp", "get_decomp_rule", "has_decomp_rule",
+    "prim_guard", "enable_prim", "prim_enabled",
+]

@@ -1,0 +1,102 @@
+"""Primitive decompositions of composite ops (reference:
+decomposition/rules.py — same op list, expressed over jnp primitives
+rather than C++ prim ops)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .register import register_decomp
+
+
+@register_decomp("softmax")
+def softmax(x, axis=-1):
+    m = jnp.max(x, axis=axis, keepdims=True)
+    e = jnp.exp(x - m)
+    return e / jnp.sum(e, axis=axis, keepdims=True)
+
+
+@register_decomp("log_softmax")
+def log_softmax(x, axis=-1):
+    m = jnp.max(x, axis=axis, keepdims=True)
+    s = x - m
+    return s - jnp.log(jnp.sum(jnp.exp(s), axis=axis, keepdims=True))
+
+
+@register_decomp("gelu")
+def gelu(x, approximate=False):
+    if approximate:
+        c = jnp.sqrt(jnp.asarray(2.0 / jnp.pi, x.dtype))
+        return 0.5 * x * (1 + jnp.tanh(c * (x + 0.044715 * x ** 3)))
+    from jax.scipy.special import erf
+    return 0.5 * x * (1 + erf(x / jnp.sqrt(jnp.asarray(2.0, x.dtype))))
+
+
+@register_decomp("silu")
+def silu(x):
+    return x * (1 / (1 + jnp.exp(-x)))
+
+
+@register_decomp("layer_norm")
+def layer_norm(x, scale=None, bias=None, epsilon=1e-5,
+               begin_norm_axis=-1):
+    axes = tuple(range(begin_norm_axis if begin_norm_axis >= 0
+                       else x.ndim + begin_norm_axis, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean((x - mean) ** 2, axis=axes, keepdims=True)
+    out = (x - mean) / jnp.sqrt(var + epsilon)
+    if scale is not None:
+        out = out * scale
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_decomp("rms_norm")
+def rms_norm(x, scale=None, epsilon=1e-6):
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    out = x / jnp.sqrt(var + epsilon)
+    return out * scale if scale is not None else out
+
+
+@register_decomp("batch_norm")
+def batch_norm(x, mean, variance, scale=None, bias=None, epsilon=1e-5,
+               data_format="NCHW"):
+    shape = [1, -1] + [1] * (x.ndim - 2) if data_format == "NCHW" \
+        else [1] * (x.ndim - 1) + [-1]
+    out = (x - mean.reshape(shape)) / jnp.sqrt(
+        variance.reshape(shape) + epsilon)
+    if scale is not None:
+        out = out * scale.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@register_decomp("dropout")
+def dropout(x, mask, p=0.5):
+    return x * mask / (1.0 - p)
+
+
+@register_decomp("mean")
+def mean(x, axis=None, keepdim=False):
+    n = x.size if axis is None else jnp.prod(
+        jnp.asarray([x.shape[a] for a in
+                     (axis if isinstance(axis, (list, tuple)) else [axis])]))
+    return jnp.sum(x, axis=axis, keepdims=keepdim) / n
+
+
+@register_decomp("sigmoid")
+def sigmoid(x):
+    return 1 / (1 + jnp.exp(-x))
+
+
+@register_decomp("swiglu")
+def swiglu(x, y=None):
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return x * (1 / (1 + jnp.exp(-x))) * y
+
+
+@register_decomp("squared_l2_norm")
+def squared_l2_norm(x):
+    return jnp.sum(x * x)

@@ -41,6 +41,30 @@ rfftn = _fftn_op("rfftn", jnp.fft.rfftn)
 irfftn = _fftn_op("irfftn", jnp.fft.irfftn)
 
 
+_INV_NORM = {"backward": "forward", "forward": "backward", "ortho": "ortho"}
+
+
+def _hfftn(a, s=None, axes=None, norm="backward"):
+    # Hermitian-input n-d FFT via the identity hfftn(a) =
+    # irfftn(conj(a)) with the norm convention swapped (scipy.fft.hfftn
+    # semantics; jnp only ships the 1-d hfft). reference: fft.py hfftn.
+    return jnp.fft.irfftn(jnp.conj(a), s=s, axes=axes,
+                          norm=_INV_NORM[norm])
+
+
+def _ihfftn(a, s=None, axes=None, norm="backward"):
+    return jnp.conj(jnp.fft.rfftn(a, s=s, axes=axes,
+                                  norm=_INV_NORM[norm]))
+
+
+hfftn = _fftn_op("hfftn", _hfftn)
+ihfftn = _fftn_op("ihfftn", _ihfftn)
+hfft2 = _fftn_op("hfft2", lambda a, s, axes, norm: _hfftn(
+    a, s=s, axes=axes or (-2, -1), norm=norm))
+ihfft2 = _fftn_op("ihfft2", lambda a, s, axes, norm: _ihfftn(
+    a, s=s, axes=axes or (-2, -1), norm=norm))
+
+
 def fftfreq(n, d=1.0, dtype=None, name=None):
     from paddle_tpu.core.tensor import Tensor
     return Tensor._wrap(jnp.fft.fftfreq(n, d))

@@ -91,3 +91,136 @@ def send_ue_recv(x, e, src_index, dst_index, message_op="add",
                "min": jax.ops.segment_min}[reduce_op]
         return red(msgs, d, num_segments=n)
     return run_op("send_ue_recv", f, x, e, src_index, dst_index)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message op(x[src], y[dst]) with NO reduce (reference
+    geometric/message_passing/send_recv.py:413)."""
+    def f(a, b, src, dst):
+        xs = jnp.take(a, src.astype(jnp.int32), axis=0)
+        yd = jnp.take(b, dst.astype(jnp.int32), axis=0)
+        return {"add": xs + yd, "sub": xs - yd, "mul": xs * yd,
+                "div": xs / yd}[message_op]
+    return run_op("send_uv", f, x, y, src_index, dst_index)
+
+
+# ------------------------------------------------------------------
+# Graph sampling / reindex: host-side input-pipeline ops on a CSC
+# graph (reference geometric/sampling/neighbors.py:30, reindex.py:32 —
+# phi graph_sample_neighbors / reindex_graph kernels). On TPU the
+# sampling stage lives in the host data pipeline, so these are numpy.
+# ------------------------------------------------------------------
+
+def _np1d(t):
+    import numpy as np
+    return np.asarray(t._data if isinstance(t, Tensor) else t).reshape(-1)
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    import numpy as np
+    if return_eids and eids is None:
+        raise ValueError("`eids` should not be None if `return_eids` is "
+                         "True.")
+    r, cp, nodes = _np1d(row), _np1d(colptr), _np1d(input_nodes)
+    ev = _np1d(eids) if eids is not None else None
+    rng = np.random.default_rng()
+    neigh, cnt, out_eids = [], [], []
+    for n in nodes.tolist():
+        lo, hi = int(cp[n]), int(cp[n + 1])
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            idx = np.arange(lo, hi)
+        else:
+            idx = lo + rng.choice(deg, size=sample_size, replace=False)
+        neigh.append(r[idx])
+        cnt.append(len(idx))
+        if ev is not None:
+            out_eids.append(ev[idx])
+    out_n = Tensor(np.concatenate(neigh) if neigh
+                   else np.empty(0, r.dtype))
+    out_c = Tensor(np.asarray(cnt, np.int32))
+    if return_eids:
+        return out_n, out_c, Tensor(
+            np.concatenate(out_eids) if out_eids else np.empty(0, r.dtype))
+    return out_n, out_c
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    import numpy as np
+    if return_eids and eids is None:
+        raise ValueError("`eids` should not be None if `return_eids` is "
+                         "True.")
+    r, cp, nodes = _np1d(row), _np1d(colptr), _np1d(input_nodes)
+    w = _np1d(edge_weight).astype(np.float64)
+    ev = _np1d(eids) if eids is not None else None
+    rng = np.random.default_rng()
+    neigh, cnt, out_eids = [], [], []
+    for n in nodes.tolist():
+        lo, hi = int(cp[n]), int(cp[n + 1])
+        deg = hi - lo
+        if deg == 0:
+            cnt.append(0)
+            continue
+        if sample_size < 0 or deg <= sample_size:
+            idx = np.arange(lo, hi)
+        else:
+            p = w[lo:hi] / w[lo:hi].sum()
+            idx = lo + rng.choice(deg, size=sample_size, replace=False, p=p)
+        neigh.append(r[idx])
+        cnt.append(len(idx))
+        if ev is not None:
+            out_eids.append(ev[idx])
+    out_n = Tensor(np.concatenate(neigh) if neigh
+                   else np.empty(0, r.dtype))
+    out_c = Tensor(np.asarray(cnt, np.int32))
+    if return_eids:
+        return out_n, out_c, Tensor(
+            np.concatenate(out_eids) if out_eids else np.empty(0, r.dtype))
+    return out_n, out_c
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    import numpy as np
+    xs, ns, cs = _np1d(x), _np1d(neighbors), _np1d(count)
+    remap = {int(v): i for i, v in enumerate(xs.tolist())}
+    out_nodes = list(xs.tolist())
+    src = np.empty(len(ns), xs.dtype)
+    for i, v in enumerate(ns.tolist()):
+        j = remap.get(int(v))
+        if j is None:
+            j = len(out_nodes)
+            remap[int(v)] = j
+            out_nodes.append(int(v))
+        src[i] = j
+    dst = np.repeat(np.arange(len(cs), dtype=xs.dtype), cs)
+    return (Tensor(src), Tensor(dst),
+            Tensor(np.asarray(out_nodes, xs.dtype)))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """reindex over a list of per-edge-type neighbor/count tensors
+    sharing one node renumbering (reference reindex.py:heter)."""
+    import numpy as np
+    xs = _np1d(x)
+    remap = {int(v): i for i, v in enumerate(xs.tolist())}
+    out_nodes = list(xs.tolist())
+    srcs, dsts = [], []
+    for ns_t, cs_t in zip(neighbors, count):
+        ns, cs = _np1d(ns_t), _np1d(cs_t)
+        src = np.empty(len(ns), xs.dtype)
+        for i, v in enumerate(ns.tolist()):
+            j = remap.get(int(v))
+            if j is None:
+                j = len(out_nodes)
+                remap[int(v)] = j
+                out_nodes.append(int(v))
+            src[i] = j
+        srcs.append(Tensor(src))
+        dsts.append(Tensor(np.repeat(np.arange(len(cs), dtype=xs.dtype),
+                                     cs)))
+    return srcs, dsts, Tensor(np.asarray(out_nodes, xs.dtype))

@@ -113,6 +113,140 @@ class EarlyStopping(Callback):
                 self.stopped = True
 
 
+class LRScheduler(Callback):
+    """Steps the optimizer's LRScheduler per batch/epoch (reference
+    hapi/callbacks.py LRSchedulerCallback)."""
+
+    def __init__(self, by_step=True, by_epoch=False):
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        lr = getattr(opt, "_learning_rate", None)
+        return lr if hasattr(lr, "step") else None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s is not None:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if self.by_epoch and s is not None:
+            s.step()
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce LR when a metric has stopped improving (reference
+    hapi/callbacks.py ReduceLROnPlateau)."""
+
+    def __init__(self, monitor='loss', factor=0.1, patience=10, verbose=1,
+                 mode='auto', min_delta=1e-4, cooldown=0, min_lr=0):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.mode = "min" if mode == "auto" and "loss" in monitor else mode
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.best = None
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def on_eval_end(self, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        better = self.best is None or (
+            cur < self.best - self.min_delta if self.mode == "min"
+            else cur > self.best + self.min_delta)
+        if better:
+            self.best = cur
+            self.wait = 0
+        elif self.cooldown_counter <= 0:
+            self.wait += 1
+            if self.wait >= self.patience:
+                opt = getattr(self.model, "_optimizer", None)
+                if opt is not None:
+                    new_lr = max(opt.get_lr() * self.factor, self.min_lr)
+                    opt.set_lr(new_lr)
+                    if self.verbose:
+                        print(f"ReduceLROnPlateau: lr -> {new_lr:g}")
+                self.cooldown_counter = self.cooldown
+                self.wait = 0
+
+
+class VisualDL(Callback):
+    """Scalar logging callback (reference hapi/callbacks.py VisualDL;
+    the visualdl package is not in this image, so scalars go to a
+    jsonl file under log_dir)."""
+
+    def __init__(self, log_dir):
+        self.log_dir = log_dir
+        self._f = None
+
+    def _write(self, tag, logs, step):
+        import json
+        if self._f is None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._f = open(os.path.join(self.log_dir, "scalars.jsonl"),
+                           "a")
+        for k, v in (logs or {}).items():
+            try:
+                v = float(v[0] if isinstance(v, (list, tuple)) else v)
+            except (TypeError, ValueError):
+                continue
+            self._f.write(json.dumps(
+                {"tag": f"{tag}/{k}", "value": v, "step": step}) + "\n")
+        self._f.flush()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._write("train", logs, step)
+
+    def on_eval_end(self, logs=None):
+        self._write("eval", logs, 0)
+
+    def on_train_end(self, logs=None):
+        if self._f:
+            self._f.close()
+            self._f = None
+
+
+class WandbCallback(Callback):
+    """Weights&Biases logging (reference hapi/callbacks.py
+    WandbCallback); gated on the wandb package being importable."""
+
+    def __init__(self, project=None, run=None, **kwargs):
+        try:
+            import wandb
+            self.wandb = wandb
+        except ImportError:
+            raise ImportError(
+                "WandbCallback requires the `wandb` package, which is "
+                "not installed in this environment")
+        self.project = project
+        self.kwargs = kwargs
+        self.run = run
+
+    def on_train_begin(self, logs=None):
+        if self.run is None:
+            self.run = self.wandb.init(project=self.project, **self.kwargs)
+
+    def on_train_batch_end(self, step, logs=None):
+        self.run.log({k: v for k, v in (logs or {}).items()
+                      if isinstance(v, (int, float))})
+
+    def on_train_end(self, logs=None):
+        if self.run is not None:
+            self.run.finish()
+
+
 class Model:
     """Keras-like trainer (reference hapi/model.py:1082)."""
 

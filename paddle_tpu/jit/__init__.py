@@ -242,31 +242,96 @@ def enable_to_static(flag):
 
 
 def save(layer, path, input_spec=None, **configs):
-    """paddle.jit.save — persists state_dict + (optionally) the traced
-    StableHLO text for inspection/deployment."""
+    """paddle.jit.save (reference jit/api.py save): persists
+    state_dict (.pdiparams) and — when input_spec is given — the traced
+    program as a serialized jax.export artifact (.pdmodel, params
+    frozen as constants, the reference's inference-program analog) plus
+    its StableHLO text for inspection."""
     from paddle_tpu.framework.io import save as _save
     from paddle_tpu.nn.layer.layers import Layer
+    fn = layer.forward if isinstance(layer, Layer) else layer
     if isinstance(layer, Layer):
         _save(layer.state_dict(), path + ".pdiparams")
-        if input_spec:
-            try:
-                arrays = [s._data if isinstance(s, Tensor)
-                          else jnp.zeros(s.shape, s.dtype)
-                          for s in input_spec]
-                lowered = jax.jit(
-                    lambda *xs: layer(*[Tensor._wrap(x) for x in xs])._data
-                ).lower(*arrays)
-                with open(path + ".stablehlo.txt", "w") as f:
-                    f.write(lowered.as_text())
-            except Exception:
-                pass
-    else:
-        _save(layer, path + ".pdiparams")
+    if input_spec:
+        from jax import export as jexport
+        specs = []
+        for s in input_spec:
+            if isinstance(s, Tensor):
+                specs.append(jax.ShapeDtypeStruct(s.shape, s._data.dtype))
+            else:
+                shape = tuple(1 if d in (-1, None) else d for d in s.shape)
+                specs.append(jax.ShapeDtypeStruct(shape, s.dtype))
+
+        def run(*xs):
+            out = fn(*[Tensor._wrap(x) for x in xs])
+            arrs, _ = _tree_split(out)
+            return tuple(arrs)
+        exported = jexport.export(jax.jit(run))(*specs)
+        with open(path + ".pdmodel", "wb") as f:
+            f.write(bytes(exported.serialize()))
+        with open(path + ".stablehlo.txt", "w") as f:
+            f.write(exported.mlir_module())
+
+
+class TranslatedLayer:
+    """A loaded jit.save program, callable like the original Layer
+    (reference jit/translated_layer.py: runs the saved inference
+    program; here: a deserialized jax.export executable)."""
+
+    def __init__(self, exported, state=None):
+        self._exported = exported
+        self._state = state or {}
+
+    def forward(self, *args):
+        arrs = [a._data if isinstance(a, Tensor) else jnp.asarray(a)
+                for a in args]
+        outs = self._exported.call(*arrs)
+        outs = [Tensor._wrap(o) for o in outs]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    __call__ = forward
+
+    def state_dict(self):
+        return dict(self._state)
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("TranslatedLayer is an inference program; "
+                           "training it is not supported")
 
 
 def load(path, **configs):
+    """paddle.jit.load: returns a TranslatedLayer when a .pdmodel
+    program exists, else the raw state dict (reference jit/api.py
+    load)."""
+    import os as _os
     from paddle_tpu.framework.io import load as _load
-    return _load(path + ".pdiparams")
+    state = None
+    if _os.path.exists(path + ".pdiparams"):
+        state = _load(path + ".pdiparams")
+    if _os.path.exists(path + ".pdmodel"):
+        from jax import export as jexport
+        with open(path + ".pdmodel", "rb") as f:
+            exported = jexport.deserialize(bytearray(f.read()))
+        return TranslatedLayer(exported, state)
+    return state
+
+
+# --- dy2static logging knobs (reference jit/dy2static/logging_utils) ---
+_verbosity = 0
+_code_level = -1
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    global _verbosity
+    _verbosity = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    global _code_level
+    _code_level = int(level)
 
 
 class InputSpec:

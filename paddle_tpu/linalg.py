@@ -7,3 +7,6 @@ from paddle_tpu.ops.linalg import (  # noqa: F401
     triangular_solve, vector_norm,
 )
 from paddle_tpu.ops.linalg import matmul  # noqa: F401
+from paddle_tpu.ops.linalg import (  # noqa: F401
+    matrix_exp, fp8_fp8_half_gemm_fused,
+)

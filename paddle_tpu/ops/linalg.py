@@ -337,3 +337,43 @@ def ormqr(x, tau, y, left=True, transpose=False, name=None):
             q = q.T
         return q @ other if left else other @ q
     return run_op("ormqr", f, x, tau, y)
+
+
+def matrix_exp(x, name=None):
+    """matrix exponential (reference linalg.matrix_exp -> phi
+    matrix_exp kernel); jax.scipy Pade lowering on TPU."""
+    from jax.scipy.linalg import expm
+    return run_op("matrix_exp", expm, x)
+
+
+def fp8_fp8_half_gemm_fused(x, y, bias=None, transpose_x=False,
+                            transpose_y=False, scale=1.0,
+                            output_dtype="float16", act="identity",
+                            name=None):
+    """FP8xFP8 -> half GEMM (reference linalg.fp8_fp8_half_gemm_fused
+    over cutlass fp8 kernels). TPU-native: e4m3 operands fed to the MXU
+    via dot_general with a half preferred_element_type."""
+    out_dt = {"float16": jnp.float16, "bfloat16": jnp.bfloat16}[
+        str(output_dtype).replace("paddle.", "")]
+
+    def f(a, b, *rest):
+        bb = rest[0] if rest else None
+        a8 = a.astype(jnp.float8_e4m3fn)
+        b8 = b.astype(jnp.float8_e4m3fn)
+        if transpose_x:
+            a8 = jnp.swapaxes(a8, -1, -2)
+        if transpose_y:
+            b8 = jnp.swapaxes(b8, -1, -2)
+        out = jax.lax.dot_general(
+            a8, b8, (((a8.ndim - 1,), (b8.ndim - 2,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        out = out * scale
+        if bb is not None:
+            out = out + bb.astype(out.dtype)
+        if act == "gelu":
+            out = jax.nn.gelu(out)
+        elif act == "relu":
+            out = jax.nn.relu(out)
+        return out.astype(out_dt)
+    args = (x, y) + ((bias,) if bias is not None else ())
+    return run_op("fp8_fp8_half_gemm_fused", f, *args)

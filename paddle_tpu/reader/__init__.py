@@ -1,0 +1,10 @@
+"""paddle.reader equivalent — reader-composition decorators
+(reference: python/paddle/reader/decorator.py). These are pure-python
+generator combinators feeding the host input pipeline; on TPU they run
+on the host CPU exactly as in the reference."""
+from .decorator import (  # noqa: F401
+    buffered, cache, chain, compose, ComposeNotAligned, firstn,
+    map_readers, multiprocess_reader, shuffle, xmap_readers,
+)
+
+__all__ = []

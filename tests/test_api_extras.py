@@ -1,0 +1,213 @@
+"""Tests for the reader/dataset/callbacks/decomposition/jit-export API
+surface (reference analogs: python/paddle/{batch,reader,dataset,
+callbacks,decomposition}.py and jit save/load -> TranslatedLayer)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+class TestBatchAndReader:
+    def test_batch(self):
+        r = paddle.batch(lambda: iter(range(10)), 3)
+        assert [len(b) for b in r()] == [3, 3, 3, 1]
+        r = paddle.batch(lambda: iter(range(10)), 3, drop_last=True)
+        assert [len(b) for b in r()] == [3, 3, 3]
+
+    def test_reader_decorators(self):
+        import paddle_tpu.reader as reader
+        assert sorted(reader.shuffle(lambda: iter(range(20)), 5)()) == \
+            list(range(20))
+        assert list(reader.chain(lambda: iter([1, 2]),
+                                 lambda: iter([3]))()) == [1, 2, 3]
+        assert list(reader.compose(lambda: iter([1, 2]),
+                                   lambda: iter([3, 4]))()) == \
+            [(1, 3), (2, 4)]
+        with pytest.raises(reader.ComposeNotAligned):
+            list(reader.compose(lambda: iter([1]),
+                                lambda: iter([3, 4]))())
+        assert list(reader.firstn(lambda: iter(range(10)), 4)()) == \
+            [0, 1, 2, 3]
+        assert list(reader.buffered(lambda: iter(range(6)), 2)()) == \
+            list(range(6))
+        cached = reader.cache(lambda: iter(range(5)))
+        assert list(cached()) == list(cached()) == list(range(5))
+        out = list(reader.xmap_readers(lambda x: x * 2,
+                                       lambda: iter(range(8)),
+                                       3, 4, order=True)())
+        assert out == [0, 2, 4, 6, 8, 10, 12, 14]
+        out = list(reader.multiprocess_reader(
+            [lambda: iter([1, 2]), lambda: iter([3, 4])])())
+        assert sorted(out) == [1, 2, 3, 4]
+
+    def test_dataset_readers(self):
+        import paddle_tpu.dataset as ds
+        im, lb = next(ds.mnist.train()())
+        assert im.shape == (784,) and im.dtype == np.float32
+        x, y = next(ds.uci_housing.train()())
+        assert x.shape == (13,) and y.shape == (1,)
+        im, lb = next(ds.cifar.train10()())
+        assert im.shape == (3072,)
+        ids, lab = next(ds.imdb.train()())
+        assert isinstance(ids, list) and lab in (0, 1)
+        src, trg, nxt = next(ds.wmt16.train(1000, 1000)())
+        assert len(nxt) == len(trg)
+
+
+class TestDecomposition:
+    def test_rules_match_ops(self):
+        import jax.numpy as jnp
+        import paddle_tpu.decomposition as dc
+        a = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        sm = np.asarray(dc.get_decomp_rule("softmax")(jnp.asarray(a)))
+        ref = paddle.nn.functional.softmax(
+            paddle.to_tensor(a), axis=-1).numpy()
+        np.testing.assert_allclose(sm, ref, rtol=1e-5)
+        ln = np.asarray(dc.get_decomp_rule("layer_norm")(jnp.asarray(a)))
+        ref = paddle.nn.functional.layer_norm(
+            paddle.to_tensor(a), normalized_shape=[8]).numpy()
+        np.testing.assert_allclose(ln, ref, rtol=1e-4, atol=1e-5)
+
+    def test_prim_guard(self):
+        import paddle_tpu.decomposition as dc
+        assert not dc.prim_enabled()
+        with dc.prim_guard():
+            assert dc.prim_enabled()
+        assert not dc.prim_enabled()
+
+    def test_decompose_whitelist_validation(self):
+        import paddle_tpu.decomposition as dc
+        with pytest.raises(ValueError):
+            dc.decompose(None, whitelist={"not_a_real_op"})
+
+
+class TestHermitianFFT:
+    def test_hfftn_vs_scipy(self):
+        import scipy.fft as sfft
+        rs = np.random.RandomState(0)
+        a = (rs.randn(4, 6) + 1j * rs.randn(4, 6)).astype(np.complex64)
+        for norm in ("backward", "forward", "ortho"):
+            mine = paddle.fft.hfftn(paddle.to_tensor(a), norm=norm).numpy()
+            np.testing.assert_allclose(mine, sfft.hfftn(a, norm=norm),
+                                       rtol=2e-4, atol=1e-4)
+            r = rs.randn(4, 6).astype(np.float32)
+            mine = paddle.fft.ihfftn(paddle.to_tensor(r),
+                                     norm=norm).numpy()
+            np.testing.assert_allclose(mine, sfft.ihfftn(r, norm=norm),
+                                       rtol=2e-4, atol=1e-4)
+
+
+class TestLinalgAdditions:
+    def test_matrix_exp(self):
+        import scipy.linalg as sla
+        a = np.random.RandomState(0).randn(4, 4).astype(np.float32) * 0.3
+        mine = paddle.linalg.matrix_exp(paddle.to_tensor(a)).numpy()
+        np.testing.assert_allclose(mine, sla.expm(a), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_fp8_gemm(self):
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(8, 16).astype(np.float32))
+        y = paddle.to_tensor(rs.randn(16, 8).astype(np.float32))
+        out = paddle.linalg.fp8_fp8_half_gemm_fused(
+            x, y, output_dtype="bfloat16")
+        assert out.numpy().shape == (8, 8)
+        # fp8 quantization error is large; just check correlation
+        ref = x.numpy() @ y.numpy()
+        got = out.numpy().astype(np.float32)
+        cc = np.corrcoef(ref.ravel(), got.ravel())[0, 1]
+        assert cc > 0.98, cc
+
+
+class TestSavedTensorsHooks:
+    def test_pack_unpack(self):
+        from paddle_tpu.autograd import PyLayer, saved_tensors_hooks
+        events = []
+
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, g):
+                (x,) = ctx.saved_tensor
+                return g * 2
+
+        def pack(t):
+            events.append("pack")
+            return t.numpy()          # e.g. offload to host
+
+        def unpack(p):
+            events.append("unpack")
+            return paddle.to_tensor(p)
+
+        with saved_tensors_hooks(pack, unpack):
+            x = paddle.to_tensor(np.ones(3, np.float32),
+                                 stop_gradient=False)
+            Double.apply(x).sum().backward()
+        assert events == ["pack", "unpack"]
+        np.testing.assert_allclose(x.grad.numpy(), 2 * np.ones(3))
+
+
+class TestJitExport:
+    def test_save_load_translated_layer(self, tmp_path):
+        from paddle_tpu.jit import InputSpec, TranslatedLayer
+        lin = nn.Linear(4, 2)
+        path = str(tmp_path / "m")
+        paddle.jit.save(lin, path, input_spec=[InputSpec([1, 4],
+                                                         "float32")])
+        tl = paddle.jit.load(path)
+        assert isinstance(tl, TranslatedLayer)
+        x = np.random.RandomState(0).randn(1, 4).astype(np.float32)
+        np.testing.assert_allclose(tl(paddle.to_tensor(x)).numpy(),
+                                   lin(paddle.to_tensor(x)).numpy(),
+                                   rtol=1e-5)
+        assert set(tl.state_dict()) == set(lin.state_dict())
+        with pytest.raises(RuntimeError):
+            tl.train()
+
+
+class TestGeometricSampling:
+    def test_sample_and_reindex(self):
+        row = paddle.to_tensor([3, 7, 0, 9, 1, 4, 2, 9, 3, 9, 1, 9, 7],
+                               dtype="int64")
+        colptr = paddle.to_tensor([0, 2, 4, 5, 6, 7, 9, 11, 11, 13, 13],
+                                  dtype="int64")
+        nodes = paddle.to_tensor([0, 8, 1, 2], dtype="int64")
+        n, c = paddle.geometric.sample_neighbors(row, colptr, nodes,
+                                                 sample_size=2)
+        assert c.numpy().tolist() == [2, 2, 2, 1]
+        x = paddle.to_tensor([0, 1, 2], dtype="int64")
+        nb = paddle.to_tensor([8, 9, 0, 4, 7, 6, 7], dtype="int64")
+        ct = paddle.to_tensor([2, 3, 2], dtype="int32")
+        s, d, o = paddle.geometric.reindex_graph(x, nb, ct)
+        assert s.numpy().tolist() == [3, 4, 0, 5, 6, 7, 6]
+        assert d.numpy().tolist() == [0, 0, 1, 1, 1, 2, 2]
+        assert o.numpy().tolist() == [0, 1, 2, 8, 9, 4, 7, 6]
+
+
+class TestCallbacks:
+    def test_reduce_lr_on_plateau(self):
+        import paddle_tpu.callbacks as cb
+        lin = nn.Linear(2, 2)
+        opt = paddle.optimizer.SGD(learning_rate=1.0,
+                                   parameters=lin.parameters())
+        c = cb.ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                                 verbose=0)
+        c.set_model(type("M", (), {"_optimizer": opt})())
+        c.on_eval_end({"loss": 1.0})
+        c.on_eval_end({"loss": 1.0})   # wait=1 >= patience -> reduce
+        assert abs(opt.get_lr() - 0.5) < 1e-9
+
+    def test_visualdl_writes_scalars(self, tmp_path):
+        import json
+        import paddle_tpu.callbacks as cb
+        v = cb.VisualDL(str(tmp_path))
+        v.on_train_batch_end(0, {"loss": 1.5})
+        v.on_train_end()
+        lines = [json.loads(ln) for ln in
+                 open(tmp_path / "scalars.jsonl")]
+        assert lines[0]["tag"] == "train/loss"
