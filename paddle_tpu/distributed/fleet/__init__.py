@@ -25,10 +25,13 @@ from .sequence_parallel_utils import (
     ColumnSequenceParallelLinear, RowSequenceParallelLinear,
     mark_as_sequence_parallel_parameter,
     register_sequence_parallel_allreduce_hooks)
+from ..ps import PaddleCloudRoleMaker  # noqa: F401
 
 __all__ = [
     "init", "DistributedStrategy", "distributed_model",
     "distributed_optimizer", "get_hybrid_communicate_group",
+    "PaddleCloudRoleMaker", "is_server", "is_worker", "init_server",
+    "run_server", "init_worker", "stop_worker",
     "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
     "ParallelCrossEntropy", "get_rng_state_tracker", "recompute",
     "LayerDesc", "PipelineLayer",
@@ -70,9 +73,18 @@ class _Fleet:
         self._strategy = None
         self._hcg = None
         self._is_init = False
+        self._role_maker = None
+        self._ps_server = None
+        self._ps_client = None
 
     def init(self, role_maker=None, is_collective=True, strategy=None,
              log_level="INFO"):
+        if role_maker is not None and not is_collective:
+            # parameter-server mode (reference fleet PS flow)
+            self._role_maker = role_maker
+            self._strategy = strategy or DistributedStrategy()
+            self._is_init = True
+            return self
         init_parallel_env()
         self._strategy = strategy or DistributedStrategy()
         hc = self._strategy.hybrid_configs
@@ -133,6 +145,42 @@ class _Fleet:
         return HybridParallelOptimizer(optimizer, self._hcg,
                                        strategy or self._strategy)
 
+    # --------------------------------------------- parameter-server mode
+    # (reference fleet.py init_server/run_server/init_worker/stop_worker)
+    def is_server(self):
+        return self._role_maker is not None and \
+            self._role_maker.is_server()
+
+    def is_worker(self):
+        return self._role_maker is None or self._role_maker.is_worker()
+
+    def init_server(self, *args, **kwargs):
+        from ..ps import PsServer
+        rm = self._role_maker
+        self._ps_server = PsServer(
+            host="0.0.0.0", port=rm.server_port(),
+            num_workers=rm.worker_num())
+
+    def run_server(self):
+        if self._ps_server is None:
+            self.init_server()
+        self._ps_server.run()
+
+    def init_worker(self, scopes=None):
+        from ..ps import PsClient
+        self._ps_client = PsClient(self._role_maker.server_endpoints())
+
+    def stop_worker(self):
+        if self._ps_client is not None:
+            if self._role_maker.is_first_worker():
+                self._ps_client.stop_servers()
+            self._ps_client.close()
+            self._ps_client = None
+
+    @property
+    def ps_client(self):
+        return self._ps_client
+
 
 class HybridParallelOptimizer:
     """reference hybrid_parallel_optimizer.py:258: grad clip across groups
@@ -188,3 +236,27 @@ def worker_num():
 
 def worker_index():
     return _fleet.worker_index()
+
+
+def is_server():
+    return _fleet.is_server()
+
+
+def is_worker():
+    return _fleet.is_worker()
+
+
+def init_server(*args, **kwargs):
+    return _fleet.init_server(*args, **kwargs)
+
+
+def run_server():
+    return _fleet.run_server()
+
+
+def init_worker(scopes=None):
+    return _fleet.init_worker(scopes)
+
+
+def stop_worker():
+    return _fleet.stop_worker()

@@ -1,0 +1,131 @@
+"""Parameter-server training runtime (reference:
+paddle/fluid/distributed/ps — brpc PS with dense/sparse/geo tables —
+and python/paddle/distributed/fleet PS mode).
+
+TPU framing: PS mode serves sparse-dominated workloads (recommender
+embeddings) where the embedding table exceeds device memory. The dense
+compute path stays on TPU via the normal eager/jit stack; the sparse
+path pulls rows into host numpy, feeds them to the device step as
+ordinary inputs, and pushes gradients (or Geo deltas) back to host-side
+tables. Role topology (server/worker), table sharding by id-hash, and
+the a_sync/geo strategy knobs mirror the reference.
+
+Usage (mirrors reference fleet PS flow):
+    role = PaddleCloudRoleMaker()          # reads TRAINING_ROLE etc.
+    if role.is_server():
+        server = PsServer(num_workers=role.worker_num())
+        server.run()                       # blocks
+    else:
+        client = PsClient(role.server_endpoints())
+        ...pull/push...
+"""
+from __future__ import annotations
+
+import os
+
+from .rpc import RpcClient, RpcServer  # noqa: F401
+from .server import PsServer  # noqa: F401
+from .table import (  # noqa: F401
+    DenseTable, SparseGeoTable, SparseTable,
+)
+from .worker import PsClient  # noqa: F401
+
+
+class PaddleCloudRoleMaker:
+    """Role discovery from env vars (reference
+    fleet/base/role_maker.py PaddleCloudRoleMaker):
+    TRAINING_ROLE=TRAINER|PSERVER, PADDLE_PSERVERS_IP_PORT_LIST,
+    PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ID, PADDLE_PORT."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        self._role = os.environ.get("TRAINING_ROLE", "TRAINER")
+        self._servers = [e for e in os.environ.get(
+            "PADDLE_PSERVERS_IP_PORT_LIST", "").split(",") if e]
+        self._num_workers = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        self._worker_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        self._port = int(os.environ.get("PADDLE_PORT", 0))
+
+    def is_server(self):
+        return self._role == "PSERVER"
+
+    def is_worker(self):
+        return self._role == "TRAINER"
+
+    def is_first_worker(self):
+        return self.is_worker() and self._worker_id == 0
+
+    def worker_num(self):
+        return self._num_workers
+
+    def worker_index(self):
+        return self._worker_id
+
+    def server_num(self):
+        return len(self._servers)
+
+    def server_endpoints(self):
+        return list(self._servers)
+
+    def server_port(self):
+        return self._port
+
+
+class GeoWorker:
+    """Geo-SGD async worker (reference GeoSGD: train a local replica,
+    push parameter deltas every `trainer_desc.push_step` steps, pull
+    fresh global params; memory_sparse_geo_table applies deltas
+    additively)."""
+
+    def __init__(self, client: PsClient, table_id: int, dim: int,
+                 push_interval: int = 10):
+        self._client = client
+        self._table_id = table_id
+        self._dim = dim
+        self._interval = push_interval
+        self._step = 0
+        self._local = {}       # id -> local row
+        self._base = {}        # id -> row at last sync
+
+    def lookup(self, keys):
+        """Pull any unseen rows, return the local replica rows."""
+        import numpy as np
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        missing = [k for k in keys.tolist() if k not in self._local]
+        if missing:
+            rows = self._client.pull_sparse(
+                self._table_id, np.asarray(missing, np.int64))
+            for k, r in zip(missing, rows):
+                self._local[k] = r.copy()
+                self._base[k] = r.copy()
+        import numpy as _np
+        return _np.stack([self._local[int(k)] for k in keys])
+
+    def apply_grads(self, keys, grads, lr):
+        import numpy as np
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        for k, g in zip(keys.tolist(), grads):
+            self._local[k] -= lr * g
+        self._step += 1
+        if self._step % self._interval == 0:
+            self.sync()
+
+    def sync(self):
+        """Push local deltas; refresh base to the pushed state."""
+        import numpy as np
+        if not self._local:
+            return
+        keys = np.asarray(list(self._local), np.int64)
+        deltas = np.stack([self._local[int(k)] - self._base[int(k)]
+                           for k in keys])
+        self._client.push_sparse(self._table_id, keys, deltas)
+        rows = self._client.pull_sparse(self._table_id, keys)
+        for k, r in zip(keys.tolist(), rows):
+            self._local[k] = r.copy()
+            self._base[k] = r.copy()
+
+
+__all__ = [
+    "PsServer", "PsClient", "DenseTable", "SparseTable",
+    "SparseGeoTable", "PaddleCloudRoleMaker", "GeoWorker", "RpcServer",
+    "RpcClient",
+]

@@ -61,12 +61,22 @@ class Predictor:
     def __init__(self, config: Config):
         self._config = config
         self._layer = config._layer
+        if self._layer is None and config.model_path:
+            # serve a jit.save artifact: <path>.pdmodel is a serialized
+            # jax.export program, loaded as a TranslatedLayer
+            import os
+            path = config.model_path
+            for suffix in (".pdmodel", ".json"):
+                if path.endswith(suffix):
+                    path = path[:-len(suffix)]
+            if os.path.exists(path + ".pdmodel"):
+                self._layer = paddle.jit.load(path)
         if self._layer is None:
             raise NotImplementedError(
-                "the predictor needs a Layer to serve; use "
+                "the predictor needs a model: pass Config(model_path) "
+                "pointing at a paddle_tpu.jit.save artifact, or use "
                 "Config.set_layer(layer) (+ layer.set_state_dict("
-                "paddle.load(...)) for file-based weights) or "
-                "paddle_tpu.jit.load")
+                "paddle.load(...)) for file-based weights)")
         self._inputs: Dict[str, Tensor] = {}
         self._compiled = None
         self._last_out: Optional[Tensor] = None
@@ -91,10 +101,14 @@ class Predictor:
         args = [a if isinstance(a, Tensor) else paddle.to_tensor(a)
                 for a in args]
         if self._compiled is None:
+            from paddle_tpu.jit import TranslatedLayer
             self._layer.eval()
-            self._compiled = paddle.jit.to_static(
-                lambda *xs: self._layer(*xs), objs=[self._layer],
-                donate=False)
+            if isinstance(self._layer, TranslatedLayer):
+                self._compiled = self._layer   # already a compiled program
+            else:
+                self._compiled = paddle.jit.to_static(
+                    lambda *xs: self._layer(*xs), objs=[self._layer],
+                    donate=False)
         with paddle.no_grad():
             out = self._compiled(*args)
         self._last_out = out if isinstance(out, Tensor) else out[0]
