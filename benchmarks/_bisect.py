@@ -1,0 +1,47 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time, functools
+import numpy as np
+import jax, jax.numpy as jnp
+from paddle_tpu.models.gpt import GPTConfig
+from paddle_tpu.models.gpt_hybrid import (ParallelConfig, setup, loss_fn,
+                                          forward, adamw_update)
+
+cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
+                num_heads=16, max_seq_len=1024)
+pcfg = ParallelConfig(dp=1, pp=1, tp=1, remat=True, remat_policy="dots",
+                      param_dtype=jnp.bfloat16, compute_dtype=jnp.bfloat16)
+mesh, params, opt_state, step = setup(cfg, pcfg, seed=0,
+                                      devices=jax.devices()[:1])
+rng = np.random.RandomState(0)
+B = 8
+ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, 1024)))
+
+def bench(name, fn, *args, steps=6, warmup=2):
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.tree_util.tree_map(lambda a: np.asarray(jax.device_get(
+        jax.tree_util.tree_leaves(a)[0].ravel()[0])), out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    # sync via tiny readback
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(jax.device_get(leaf.ravel()[0]))
+    dt = (time.perf_counter() - t0) / steps
+    print(f"{name}: {dt*1000:.1f} ms/step -> {B*1024/dt:,.0f} tok/s", flush=True)
+    return dt
+
+with mesh:
+    fwd = jax.jit(lambda p, i: loss_fn(p, (i, i), cfg, pcfg, mesh))
+    bench("fwd+loss", fwd, params, ids)
+
+    vg = jax.jit(lambda p, i: jax.value_and_grad(
+        lambda q: loss_fn(q, (i, i), cfg, pcfg, mesh))(p))
+    bench("fwd+bwd", vg, params, ids)
+
+    bench("full step (donated)", step, params, opt_state, (ids, ids))
+
+    # forward without the LM-head logsumexp (isolate vocab cost)
+    fwd_only = jax.jit(lambda p, i: forward(p, i, cfg, pcfg, mesh).sum())
+    bench("fwd logits only", fwd_only, params, ids)
