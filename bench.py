@@ -42,11 +42,15 @@ def main():
                         num_heads=4, max_seq_len=128)
         batch, seq, steps, warmup = 2, 128, 3, 1
     else:
-        # GPT-medium-class (~350M params) — fits v5e 16GB with remat
+        # GPT-medium-class (~350M params) — fits v5e 16GB with remat.
+        # 8 heads x 128-dim (same params as 16x64): head_dim 128 keeps
+        # the MXU lanes full; 16x64 costs ~1.8ms/layer extra in the
+        # flash kernel (benchmarks/_attn_d128.py)
         cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
-                        num_heads=16, max_seq_len=1024)
+                        num_heads=8, max_seq_len=1024)
         batch, seq, steps, warmup = 8, 1024, 10, 2
     pcfg = ParallelConfig(dp=1, pp=1, tp=1, remat=True,
+                          remat_policy="names",
                           param_dtype=jnp.bfloat16,
                           compute_dtype=jnp.bfloat16)
     mesh, params, opt_state, step = setup(cfg, pcfg, seed=0,

@@ -1,0 +1,85 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time, math, functools
+import numpy as np
+import jax, jax.numpy as jnp
+
+def timeit(name, fn, *args, steps=10, warmup=3):
+    f = jax.jit(fn)
+    try:
+        out = None
+        for _ in range(warmup):
+            out = f(*args)
+        np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0]))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = f(*args)
+        np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0]))
+        dt = (time.perf_counter() - t0) / steps
+        print(f"{name}: {dt*1e3/24:.3f} ms/layer ({dt*1e3:.1f} ms/24)", flush=True)
+    except Exception as e:
+        print(f"{name}: FAIL {type(e).__name__} {str(e)[:100]}", flush=True)
+
+key = jax.random.PRNGKey(0)
+B, S, NH, D = 8, 1024, 16, 64
+q = jax.random.normal(key, (B, NH, S, D), jnp.bfloat16)
+
+from jax.experimental.pallas.ops.tpu.flash_attention import (
+    BlockSizes, flash_attention as fa)
+
+def chain(att):
+    def run(q):
+        for _ in range(24):
+            q = att(q)
+        return q
+    return run
+
+timeit("pallas flash default x24", chain(
+    lambda q: fa(q, q, q, causal=True, sm_scale=1/math.sqrt(D))), q)
+
+blk = BlockSizes(block_q=512, block_k_major=512, block_k=512, block_b=1,
+                 block_q_major_dkv=512, block_k_major_dkv=512,
+                 block_k_dkv=512, block_q_dkv=512,
+                 block_k_major_dq=512, block_k_dq=512, block_q_dq=512)
+timeit("pallas flash blk512 x24", chain(
+    lambda q: fa(q, q, q, causal=True, sm_scale=1/math.sqrt(D),
+                 block_sizes=blk)), q)
+
+def naive(q):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, q) / math.sqrt(D)
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    s = jnp.where(mask, s, -1e9).astype(jnp.float32)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, q)
+timeit("naive x24", chain(naive), q)
+
+qs = jnp.swapaxes(q, 1, 2)
+def run_jnn(qs):
+    for _ in range(24):
+        qs = jax.nn.dot_product_attention(qs, qs, qs, is_causal=True)
+    return qs
+timeit("jax.nn.dpa x24", run_jnn, qs)
+
+from jax.experimental.pallas.ops.tpu.splash_attention import (
+    splash_attention_kernel as sk, splash_attention_mask as sm)
+mask = sm.MultiHeadMask([sm.CausalMask((S, S))] * NH)
+kernel = sk.make_splash_mha(mask, head_shards=1, q_seq_shards=1)
+def run_splash(q):
+    for _ in range(24):
+        q = jax.vmap(kernel)(q * (1/math.sqrt(D)), q, q)
+    return q
+timeit("splash x24", run_splash, q)
+
+# grad through 24-chain, flash vs naive
+def g24(att):
+    def run(q):
+        def f(t):
+            for _ in range(24):
+                t = att(t)
+            return t.astype(jnp.float32).sum()
+        return jax.grad(f)(q)
+    return run
+timeit("flash default x24 fwd+bwd", g24(
+    lambda q: fa(q, q, q, causal=True, sm_scale=1/math.sqrt(D))), q)
+timeit("naive x24 fwd+bwd", g24(naive), q)
+timeit("splash x24 fwd+bwd", g24(
+    lambda t: jax.vmap(kernel)(t * (1/math.sqrt(D)), t, t)), q)

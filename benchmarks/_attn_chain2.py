@@ -1,0 +1,65 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time, math
+import numpy as np
+import jax, jax.numpy as jnp
+
+def timeit(name, fn, *args, steps=10, warmup=3):
+    f = jax.jit(fn)
+    try:
+        out = None
+        for _ in range(warmup):
+            out = f(*args)
+        np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0]))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = f(*args)
+        np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0]))
+        dt = (time.perf_counter() - t0) / steps
+        print(f"{name}: {dt*1e3/24:.3f} ms/layer", flush=True)
+    except Exception as e:
+        print(f"{name}: FAIL {type(e).__name__} {str(e)[:100]}", flush=True)
+
+key = jax.random.PRNGKey(0)
+B, S, NH, D = 8, 1024, 16, 64
+q = jax.random.normal(key, (B, NH, S, D), jnp.bfloat16)
+
+from jax.experimental.pallas.ops.tpu.flash_attention import (
+    BlockSizes, flash_attention as fa)
+blk = BlockSizes(block_q=512, block_k_major=512, block_k=512, block_b=1,
+                 block_q_major_dkv=512, block_k_major_dkv=512,
+                 block_k_dkv=512, block_q_dkv=512,
+                 block_k_major_dq=512, block_k_dq=512, block_q_dq=512)
+
+def g24(att):
+    def run(q):
+        def f(t):
+            for _ in range(24):
+                t = att(t)
+            return t.astype(jnp.float32).sum()
+        return jax.grad(f)(q)
+    return run
+
+timeit("flash blk512 x24 fwd+bwd", g24(
+    lambda t: fa(t, t, t, causal=True, sm_scale=1/math.sqrt(D),
+                 block_sizes=blk)), q)
+
+mask = jnp.tril(jnp.ones((S, S), bool))
+def naive_f32(t):
+    s = jnp.einsum("bhqd,bhkd->bhqk", t, t) / math.sqrt(D)
+    s = jnp.where(mask, s, -1e9).astype(jnp.float32)
+    p = jax.nn.softmax(s, axis=-1).astype(t.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, t)
+timeit("naive f32-softmax x24 fwd+bwd", g24(naive_f32), q)
+
+def naive_bf16(t):
+    s = jnp.einsum("bhqd,bhkd->bhqk", t, t) / math.sqrt(D)
+    s = jnp.where(mask, s, jnp.asarray(-30000., s.dtype))
+    m = jax.lax.stop_gradient(jnp.max(s, -1, keepdims=True))
+    e = jnp.exp((s - m).astype(jnp.float32)).astype(t.dtype)
+    p = e / jnp.sum(e.astype(jnp.float32), -1, keepdims=True).astype(t.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, t)
+timeit("naive bf16-ish x24 fwd+bwd", g24(naive_bf16), q)
+
+# naive under jax.checkpoint (as it will run inside remat block)
+timeit("naive f32 x24 fwd+bwd remat", g24(
+    lambda t: jax.checkpoint(naive_f32)(t)), q)

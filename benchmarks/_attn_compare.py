@@ -1,0 +1,67 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time, math
+import numpy as np
+import jax, jax.numpy as jnp
+
+def timeit(name, fn, *args, steps=20, warmup=5):
+    f = jax.jit(fn)
+    try:
+        out = None
+        for _ in range(warmup):
+            out = f(*args)
+        np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0]))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = f(*args)
+        np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0]))
+        dt = (time.perf_counter() - t0) / steps
+        print(f"{name}: {dt*1e3:.2f} ms", flush=True)
+    except Exception as e:
+        print(f"{name}: FAIL {type(e).__name__} {str(e)[:100]}", flush=True)
+
+key = jax.random.PRNGKey(0)
+B, S, NH, D = 8, 1024, 16, 64
+q = jax.random.normal(key, (B, NH, S, D), jnp.bfloat16)  # BHSD
+
+# 1. pallas flash, library-default blocks
+from jax.experimental.pallas.ops.tpu.flash_attention import flash_attention as fa
+def flash_default(q):
+    return fa(q, q, q, causal=True, sm_scale=1/math.sqrt(D))
+timeit("pallas flash (default blocks)", flash_default, q)
+
+# 2. naive attention bf16
+def naive(q):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, q) / math.sqrt(D)
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    s = jnp.where(mask, s, -1e9).astype(jnp.float32)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, q)
+timeit("naive XLA attention", naive, q)
+
+# 3. jax.nn.dot_product_attention (BSHD layout)
+qs = jnp.swapaxes(q, 1, 2)
+def jnn(qs):
+    return jax.nn.dot_product_attention(qs, qs, qs, is_causal=True)
+timeit("jax.nn.dot_product_attention", jnn, qs)
+
+# 4. splash attention
+try:
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk,
+        splash_attention_mask as sm)
+    mask = sm.CausalMask((S, S))
+    mmask = sm.MultiHeadMask([mask] * NH)
+    kernel = sk.make_splash_mha(mmask, head_shards=1, q_seq_shards=1)
+    def splash(q):
+        return jax.vmap(kernel)(q * (1/math.sqrt(D)), q, q)
+    timeit("splash attention", splash, q)
+except Exception as e:
+    print("splash setup FAIL", repr(e)[:120])
+
+# 5. fwd+bwd for best candidates
+def naive_grad(q):
+    return jax.grad(lambda t: naive(t).astype(jnp.float32).sum())(q)
+timeit("naive fwd+bwd", naive_grad, q)
+def flash_default_grad(q):
+    return jax.grad(lambda t: flash_default(t).astype(jnp.float32).sum())(q)
+timeit("pallas flash fwd+bwd (default)", flash_default_grad, q)

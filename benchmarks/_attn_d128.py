@@ -1,0 +1,50 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time, math
+import numpy as np
+import jax, jax.numpy as jnp
+
+def timeit(name, fn, *args, steps=10, warmup=3):
+    f = jax.jit(fn)
+    try:
+        out = None
+        for _ in range(warmup):
+            out = f(*args)
+        np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0]))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = f(*args)
+        np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0]))
+        dt = (time.perf_counter() - t0) / steps
+        print(f"{name}: {dt*1e3/24:.3f} ms/layer", flush=True)
+    except Exception as e:
+        print(f"{name}: FAIL {type(e).__name__} {str(e)[:100]}", flush=True)
+
+key = jax.random.PRNGKey(0)
+from jax.experimental.pallas.ops.tpu.flash_attention import (
+    BlockSizes, flash_attention as fa)
+
+def g24(att, q):
+    def run(q):
+        def f(t):
+            for _ in range(24):
+                t = att(t)
+            return t.astype(jnp.float32).sum()
+        return jax.grad(f)(q)
+    return run, q
+
+for NH, D in [(8, 128), (16, 64), (4, 256)]:
+    B, S = 8, 1024
+    q = jax.random.normal(key, (B, NH, S, D), jnp.bfloat16)
+    blk = BlockSizes(block_q=512, block_k_major=512, block_k=512, block_b=1,
+                     block_q_major_dkv=512, block_k_major_dkv=512,
+                     block_k_dkv=512, block_q_dkv=512,
+                     block_k_major_dq=512, block_k_dq=512, block_q_dq=512)
+    att = lambda t: fa(t, t, t, causal=True, sm_scale=1/math.sqrt(D),
+                       block_sizes=blk)
+    run, qq = g24(att, q)
+    timeit(f"flash H{NH} D{D} fwd+bwd", run, qq)
+    def fwd24(t):
+        for _ in range(24):
+            t = att(t)
+        return t
+    timeit(f"flash H{NH} D{D} fwd", fwd24, q)

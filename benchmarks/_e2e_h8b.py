@@ -1,0 +1,37 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+from paddle_tpu.models.gpt import GPTConfig
+from paddle_tpu.models.gpt_hybrid import ParallelConfig, setup
+
+rng = np.random.RandomState(0)
+
+def run(batch, heads, policy, steps=8, warmup=2):
+    cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
+                    num_heads=heads, max_seq_len=1024)
+    pcfg = ParallelConfig(dp=1, pp=1, tp=1, remat=True,
+                          remat_policy=policy,
+                          param_dtype=jnp.bfloat16,
+                          compute_dtype=jnp.bfloat16)
+    try:
+        mesh, params, opt_state, step = setup(cfg, pcfg, seed=0,
+                                              devices=jax.devices()[:1])
+        ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, 1024)))
+        with mesh:
+            for _ in range(warmup):
+                params, opt_state, loss = step(params, opt_state, (ids, ids))
+            float(loss)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                params, opt_state, loss = step(params, opt_state, (ids, ids))
+            float(loss)
+            dt = time.perf_counter() - t0
+        tps = batch * 1024 * steps / dt
+        print(f"b={batch} H={heads} {policy}: {tps:,.0f} tok/s loss={float(loss):.3f}", flush=True)
+    except Exception as e:
+        print(f"b={batch} H={heads} {policy}: FAIL {type(e).__name__} {str(e)[:90]}", flush=True)
+
+run(8, 8, "names")
+run(12, 8, "names")
+run(16, 8, "names")

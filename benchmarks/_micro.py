@@ -1,0 +1,80 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+
+def timeit(name, fn, *args, flops=None, steps=20, warmup=5):
+    f = jax.jit(fn)
+    out = None
+    for _ in range(warmup):
+        out = f(*args)
+    jax.block_until_ready(out)
+    np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0]))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = f(*args)
+    np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0]))
+    dt = (time.perf_counter() - t0) / steps
+    msg = f"{name}: {dt*1e3:.2f} ms"
+    if flops:
+        msg += f"  {flops/dt/1e12:.1f} TFLOP/s ({flops/dt/197e12*100:.0f}% of v5e peak)"
+    print(msg, flush=True)
+
+key = jax.random.PRNGKey(0)
+B, S, H = 8, 1024, 1024
+M = B * S
+
+# chained matmul to avoid independent-dispatch issues: y = (x@W)@W2...
+x = jax.random.normal(key, (M, H), jnp.bfloat16)
+w1 = jax.random.normal(key, (H, 4*H), jnp.bfloat16)
+w2 = jax.random.normal(key, (4*H, H), jnp.bfloat16)
+
+def mlp_chain(x, w1, w2):
+    for _ in range(24):
+        x = jax.nn.gelu(x @ w1) @ w2
+    return x
+timeit("24x MLP h=1024", mlp_chain, x, w1, w2,
+       flops=24*2*2*M*H*4*H)
+
+wq = jax.random.normal(key, (H, 3*H), jnp.bfloat16)
+def qkv_chain(x, w):
+    for _ in range(24):
+        x = (x @ w)[:, :H]
+    return x
+timeit("24x qkv h=1024", qkv_chain, x, wq, flops=24*2*M*H*3*H)
+
+# big matmul sanity: [8192,8192]x[8192,8192]
+a = jax.random.normal(key, (8192, 8192), jnp.bfloat16)
+def big(a):
+    return a @ a
+timeit("8192^3 matmul", big, a, flops=2*8192**3)
+
+# flash attention fwd
+from paddle_tpu.ops.pallas.flash_attention import flash_attention
+q = jax.random.normal(key, (B, S, 16, 64), jnp.bfloat16)
+def attn_fwd(q):
+    return flash_attention(q, q, q, causal=True)
+timeit("flash fwd B8 S1024 H16 D64", attn_fwd, q,
+       flops=4*B*16*S*S*64/2)  # causal half
+
+# flash fwd+bwd
+def attn_bwd(q):
+    return jax.grad(lambda t: flash_attention(t, t, t, causal=True)
+                    .astype(jnp.float32).sum())(q)
+timeit("flash fwd+bwd", attn_bwd, q, flops=4*B*16*S*S*64/2*3.5)
+
+# LM head + loss at bench shapes
+wte = jax.random.normal(key, (50304, H), jnp.bfloat16)
+hfin = jax.random.normal(key, (B, S, H), jnp.bfloat16)
+tgt = jax.random.randint(key, (B, S-1), 0, 50304)
+def lm_loss(h, w, t):
+    logits = jnp.einsum("bsh,vh->bsv", h, w)[:, :-1].astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, t[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - picked)
+timeit("LM head + CE loss", lm_loss, hfin, wte, tgt,
+       flops=2*B*S*H*50304)
+def lm_loss_grad(h, w, t):
+    return jax.grad(lm_loss, argnums=(0, 1))(h, w, t)[0]
+timeit("LM head + CE fwd+bwd", lm_loss_grad, hfin, wte, tgt,
+       flops=3*2*B*S*H*50304)

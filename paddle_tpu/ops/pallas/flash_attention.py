@@ -39,13 +39,17 @@ def flash_attention(q, k, v, causal: bool = False,
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     s_q, s_k = qt.shape[2], kt.shape[2]
+    # tuned on v5e (benchmarks/_attn_chain*.py): 512 blocks win over
+    # 1024 (VMEM pressure in the dkv/dq kernels); head_dim >= 128 is
+    # what keeps the MXU full — the model zoo defaults to 128-dim heads
+    bq = min(512, s_q)
+    bk = min(512, s_k)
     blk = BlockSizes(
-        block_q=min(512, s_q), block_k_major=min(512, s_k),
-        block_k=min(512, s_k), block_b=1,
-        block_q_major_dkv=min(512, s_q), block_k_major_dkv=min(512, s_k),
-        block_k_dkv=min(512, s_k), block_q_dkv=min(512, s_q),
-        block_k_major_dq=min(512, s_k), block_k_dq=min(512, s_k),
-        block_q_dq=min(512, s_q))
+        block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
+        block_q_major_dkv=bq, block_k_major_dkv=bk,
+        block_k_dkv=bk, block_q_dkv=bq,
+        block_k_major_dq=bk, block_k_dq=bk,
+        block_q_dq=bq)
     out = _fa(qt, kt, vt, causal=causal, sm_scale=sm_scale,
               block_sizes=blk)
     return jnp.swapaxes(out, 1, 2)

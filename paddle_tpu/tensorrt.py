@@ -1,0 +1,19 @@
+"""paddle.tensorrt equivalent (reference: python/paddle/tensorrt —
+PaddleToTensorRTConverter lowering subgraphs into TRT engines).
+
+There is no TensorRT on TPU; the inference-compiler role is XLA itself
+(paddle_tpu.inference.Predictor compiles the whole program). This
+module keeps the import surface and points users at the XLA path."""
+from __future__ import annotations
+
+__all__ = ["PaddleToTensorRTConverter"]
+
+
+class PaddleToTensorRTConverter:
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "TensorRT does not exist on TPU. The equivalent deployment "
+            "path is paddle_tpu.jit.save(layer, path, input_spec=...) "
+            "followed by paddle_tpu.inference.Predictor("
+            "Config(model_path)) — XLA compiles and optimizes the whole "
+            "program, which is the role TensorRT plays on GPU.")
