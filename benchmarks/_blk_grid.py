@@ -1,0 +1,48 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time, math, itertools
+import numpy as np
+import jax, jax.numpy as jnp
+from jax.experimental.pallas.ops.tpu.flash_attention import (
+    BlockSizes, flash_attention as fa)
+
+key = jax.random.PRNGKey(0)
+B, S, NH, D = 8, 1024, 8, 128
+q = jax.random.normal(key, (B, NH, S, D), jnp.bfloat16)
+
+def bench(blk, steps=8, warmup=2):
+    att = lambda t: fa(t, t, t, causal=True, sm_scale=1/math.sqrt(D),
+                       block_sizes=blk)
+    def f(t):
+        for _ in range(24):
+            t = att(t)
+        return t.astype(jnp.float32).sum()
+    g = jax.jit(jax.grad(f))
+    out = None
+    for _ in range(warmup):
+        out = g(q)
+    np.asarray(jax.device_get(out.ravel()[0]))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = g(q)
+    np.asarray(jax.device_get(out.ravel()[0]))
+    return (time.perf_counter() - t0) / steps / 24 * 1e3
+
+best = None
+for bq, bk, bdkv in [(512,512,512), (256,512,512), (512,256,512),
+                     (512,512,256), (256,256,256), (1024,512,512),
+                     (512,1024,512), (128,512,512), (512,512,128)]:
+    try:
+        blk = BlockSizes(
+            block_q=min(bq,S), block_k_major=min(bk,S), block_k=min(bk,S),
+            block_b=1,
+            block_q_major_dkv=min(bdkv,S), block_k_major_dkv=min(bdkv,S),
+            block_k_dkv=min(bdkv,S), block_q_dkv=min(bdkv,S),
+            block_k_major_dq=min(bdkv,S), block_k_dq=min(bdkv,S),
+            block_q_dq=min(bdkv,S))
+        ms = bench(blk)
+        print(f"bq={bq} bk={bk} bdkv={bdkv}: {ms:.3f} ms/layer", flush=True)
+        if best is None or ms < best[0]:
+            best = (ms, (bq, bk, bdkv))
+    except Exception as e:
+        print(f"bq={bq} bk={bk} bdkv={bdkv}: FAIL {str(e)[:80]}", flush=True)
+print("BEST", best, flush=True)

@@ -1,0 +1,53 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+import paddle_tpu.models.gpt_hybrid as gh
+from paddle_tpu.models.gpt import GPTConfig
+from paddle_tpu.models.gpt_hybrid import ParallelConfig, setup
+from jax import lax
+import functools
+
+rng = np.random.RandomState(0)
+
+def run(unroll, steps=8, warmup=2):
+    # monkeypatch scan unroll
+    orig = gh._stack_apply
+    def patched(blocks, x, cfg, pcfg, mesh):
+        def body(h, lp):
+            fn = functools.partial(gh._block, cfg=cfg, pcfg=pcfg, mesh=mesh)
+            if pcfg.remat:
+                fn = jax.checkpoint(
+                    fn, policy=jax.checkpoint_policies
+                    .save_only_these_names("attn_out", "ffn1", "qkv"))
+            return fn(h, lp), None
+        out, _ = lax.scan(body, x, blocks, unroll=unroll)
+        return out
+    gh._stack_apply = patched
+    try:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
+                        num_heads=8, max_seq_len=1024)
+        pcfg = ParallelConfig(dp=1, pp=1, tp=1, remat=True,
+                              remat_policy="names",
+                              param_dtype=jnp.bfloat16,
+                              compute_dtype=jnp.bfloat16)
+        mesh, params, opt_state, step = setup(cfg, pcfg, seed=0,
+                                              devices=jax.devices()[:1])
+        ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 1024)))
+        with mesh:
+            for _ in range(warmup):
+                params, opt_state, loss = step(params, opt_state, (ids, ids))
+            float(loss)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                params, opt_state, loss = step(params, opt_state, (ids, ids))
+            float(loss)
+            dt = time.perf_counter() - t0
+        print(f"unroll={unroll}: {8*1024*steps/dt:,.0f} tok/s", flush=True)
+    except Exception as e:
+        print(f"unroll={unroll}: FAIL {type(e).__name__} {str(e)[:90]}", flush=True)
+    finally:
+        gh._stack_apply = orig
+
+for u in [1, 2, 4, 24]:
+    run(u)

@@ -1,0 +1,38 @@
+"""paddle.distributed.rpc tests (reference model: test/rpc — sync/async
+invoke + worker registry)."""
+import numpy as np
+
+from paddle_tpu.distributed import rpc
+from paddle_tpu.distributed.ps.rpc import RpcClient
+
+
+def _add(a, b):
+    return a + b
+
+
+def _mat(x):
+    return (np.asarray(x) * 2).tolist()
+
+
+def test_rpc_sync_async_and_registry():
+    rpc.init_rpc("worker0", rank=0, world_size=1,
+                 master_endpoint="127.0.0.1:29431")
+    try:
+        assert rpc.rpc_sync("worker0", _add, args=(2, 3)) == 5
+        fut = rpc.rpc_async("worker0", _add, args=(4, 5))
+        assert fut.wait() == 9
+        assert rpc.get_worker_info("worker0").rank == 0
+        # a remote peer registering + invoking over the socket path
+        c = RpcClient("127.0.0.1:29431")
+        infos = c.call("register", name="w1", rank=1, ip="127.0.0.1",
+                       port=1)
+        assert set(infos) == {"worker0", "w1"}
+        import pickle
+        out = c.call("invoke", fn=pickle.dumps(_mat),
+                     args=pickle.dumps(([1, 2],)),
+                     kwargs=pickle.dumps({}))
+        assert out == [2, 4]
+        c.close()
+    finally:
+        rpc.shutdown()
+    assert rpc.get_all_worker_infos() == []
