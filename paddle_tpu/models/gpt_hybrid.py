@@ -52,6 +52,8 @@ class ParallelConfig:
     # (cuts the ~1/3 recompute FLOPs of full remat at modest memory cost)
     remat_policy: str = "full"
     zero1: bool = True        # shard adam moments over dp
+    scan_unroll: int = 1      # lax.scan unroll over layers (full unroll
+                              # buys ~4% on v5e at higher compile time)
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
 
@@ -263,7 +265,7 @@ def _stack_apply(blocks, x, cfg, pcfg, mesh):
             else:
                 fn = jax.checkpoint(fn)
         return fn(h, lp), None
-    out, _ = lax.scan(body, x, blocks)
+    out, _ = lax.scan(body, x, blocks, unroll=max(1, pcfg.scan_unroll))
     return out
 
 
