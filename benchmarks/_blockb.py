@@ -1,0 +1,40 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time, math
+import numpy as np
+import jax, jax.numpy as jnp
+from jax.experimental.pallas.ops.tpu.flash_attention import (
+    BlockSizes, flash_attention as fa)
+
+key = jax.random.PRNGKey(0)
+B, S, NH, D = 8, 1024, 8, 128
+q = jax.random.normal(key, (B, NH, S, D), jnp.bfloat16)
+
+def bench(bb, steps=8, warmup=2):
+    blk = BlockSizes(
+        block_q=512, block_k_major=512, block_k=512, block_b=bb,
+        block_q_major_dkv=512, block_k_major_dkv=512,
+        block_k_dkv=512, block_q_dkv=512,
+        block_k_major_dq=512, block_k_dq=512, block_q_dq=512)
+    att = lambda t: fa(t, t, t, causal=True, sm_scale=1/math.sqrt(D),
+                       block_sizes=blk)
+    def f(t):
+        for _ in range(24):
+            t = att(t)
+        return t.astype(jnp.float32).sum()
+    g = jax.jit(jax.grad(f))
+    try:
+        out = None
+        for _ in range(warmup):
+            out = g(q)
+        np.asarray(jax.device_get(out.ravel()[0]))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = g(q)
+        np.asarray(jax.device_get(out.ravel()[0]))
+        dt = (time.perf_counter() - t0) / steps / 24 * 1e3
+        print(f"block_b={bb}: {dt:.3f} ms/layer", flush=True)
+    except Exception as e:
+        print(f"block_b={bb}: FAIL {str(e)[:90]}", flush=True)
+
+for bb in [1, 2, 4, 8]:
+    bench(bb)

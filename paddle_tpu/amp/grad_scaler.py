@@ -1,0 +1,10 @@
+"""paddle.amp.grad_scaler module path (reference:
+python/paddle/amp/grad_scaler.py)."""
+from . import GradScaler, AmpScaler  # noqa: F401
+from enum import Enum
+
+
+class OptimizerState(Enum):
+    INIT = 0
+    UNSCALED = 1
+    STEPPED = 2

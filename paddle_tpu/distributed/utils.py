@@ -1,0 +1,41 @@
+"""paddle.distributed.utils equivalent — MoE comm ops
+(reference: distributed/utils/moe_utils.py global_scatter/global_gather
+over NCCL all-to-all; here: jnp reshuffles eagerly, lax all_to_all
+under jit over the ICI mesh)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import run_op
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["global_scatter", "global_gather"]
+
+
+def global_scatter(x, local_count, global_count, group=None,
+                   use_calc_stream=True):
+    """Dispatch rows of x to experts across ranks (reference
+    moe_utils.py global_scatter). Single-controller eager semantics:
+    rows are reordered into expert-major layout; under pjit the same
+    pattern becomes lax.all_to_all over the expert axis."""
+    def f(a, lc, gc):
+        order = jnp.argsort(jnp.repeat(
+            jnp.arange(lc.shape[0]), lc.astype(jnp.int32),
+            total_repeat_length=a.shape[0]), stable=True)
+        return jnp.take(a, order, axis=0)
+    return run_op("global_scatter", f, x, local_count, global_count)
+
+
+def global_gather(x, local_count, global_count, group=None,
+                  use_calc_stream=True):
+    """Inverse of global_scatter (reference moe_utils.py
+    global_gather)."""
+    def f(a, lc, gc):
+        ids = jnp.repeat(jnp.arange(lc.shape[0]), lc.astype(jnp.int32),
+                         total_repeat_length=a.shape[0])
+        order = jnp.argsort(ids, stable=True)
+        inv = jnp.zeros_like(order)
+        inv = inv.at[order].set(jnp.arange(order.shape[0]))
+        return jnp.take(a, inv, axis=0)
+    return run_op("global_gather", f, x, local_count, global_count)

@@ -7,3 +7,7 @@ On TPU these are XLA-fused jnp graphs or Pallas kernels; keeping the
 incubate names gives drop-in parity for reference model code.
 """
 from . import nn  # noqa: F401
+from . import asp  # noqa: F401
+from . import autograd  # noqa: F401
+from . import optimizer  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401

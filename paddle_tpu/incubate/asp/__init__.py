@@ -1,0 +1,104 @@
+"""paddle.incubate.asp equivalent (reference: incubate/asp/asp.py —
+2:4 structured sparsity: prune_model magnitude masks + an optimizer
+wrapper that re-applies masks after each step).
+
+TPU framing: the MXU has no N:M sparse mode, so ASP here preserves the
+*workflow* (masks, pruning, mask-preserving training) with dense
+masked tensors — the capability (training a 2:4-sparse model) ports,
+the speedup is GPU-hardware-specific."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["calculate_density", "decorate", "prune_model",
+           "set_excluded_layers", "reset_excluded_layers",
+           "OptimizerWithSparsityGuarantee"]
+
+_masks: Dict[int, np.ndarray] = {}
+_excluded: Dict[int, List[str]] = {}
+
+
+def calculate_density(x) -> float:
+    """reference asp.py calculate_density."""
+    a = np.asarray(x._data if hasattr(x, "_data") else x)
+    return float(np.count_nonzero(a)) / max(a.size, 1)
+
+
+def _mask_2_4(w: np.ndarray) -> np.ndarray:
+    """Keep the 2 largest-|w| of every 4 along the last dim (the n=2
+    m=4 pattern of reference get_mask_2d_best / 1d)."""
+    shape = w.shape
+    flat = w.reshape(-1)
+    pad = (-len(flat)) % 4
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, flat.dtype)])
+    groups = np.abs(flat.reshape(-1, 4))
+    order = np.argsort(groups, axis=1)
+    mask = np.ones_like(groups, bool)
+    np.put_along_axis(mask, order[:, :2], False, axis=1)
+    mask = mask.reshape(-1)
+    if pad:
+        mask = mask[:-pad]
+    return mask.reshape(shape)
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _excluded.setdefault(0, []).extend(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def _prunable(layer, p):
+    from paddle_tpu import nn
+    if p.name and any(p.name.startswith(e) or e in p.name
+                      for e in _excluded.get(0, [])):
+        return False
+    return isinstance(layer, (nn.Linear,)) and p.ndim == 2
+
+
+def prune_model(model, n=2, m=4, mask_algo='mask_1d', with_mask=True):
+    """Apply 2:4 magnitude masks to every prunable weight (reference
+    asp.py:319)."""
+    masks = {}
+    for layer in model.sublayers(include_self=True):
+        w = getattr(layer, "weight", None)
+        if w is None or not _prunable(layer, w):
+            continue
+        wn = np.asarray(w._data, np.float32)
+        mask = _mask_2_4(wn)
+        w._assign_array(jnp.asarray(wn * mask, w._data.dtype))
+        masks[id(w)] = mask
+        _masks[id(w)] = mask
+    return masks
+
+
+class OptimizerWithSparsityGuarantee:
+    """reference asp.py:233: after each optimizer step, re-apply the
+    masks so pruned entries stay zero through training."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def step(self, *args, **kwargs):
+        out = self._optimizer.step(*args, **kwargs)
+        for p in self._optimizer._parameter_list:
+            mask = _masks.get(id(p))
+            if mask is not None:
+                p._assign_array(p._data * jnp.asarray(mask,
+                                                      p._data.dtype))
+        return out
+
+    def clear_grad(self, *a, **k):
+        return self._optimizer.clear_grad(*a, **k)
+
+
+def decorate(optimizer) -> OptimizerWithSparsityGuarantee:
+    return OptimizerWithSparsityGuarantee(optimizer)

@@ -1,0 +1,126 @@
+"""paddle.incubate.autograd equivalent (reference: incubate/autograd —
+functional higher-order AD: jvp/vjp/Jacobian/Hessian + prim switches).
+
+TPU-native: these map directly onto jax's forward/reverse transforms —
+the machinery the reference builds with prim ops and double-backward
+is the compiler's native capability here."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian", "disable_prim",
+           "enable_prim", "prim_enabled", "forward_grad", "grad"]
+
+
+def _unwrap(xs):
+    if isinstance(xs, (list, tuple)):
+        return [x._data if isinstance(x, Tensor) else jnp.asarray(x)
+                for x in xs]
+    return [xs._data if isinstance(xs, Tensor) else jnp.asarray(xs)]
+
+
+def _wrap_like(arrs, template):
+    outs = [Tensor._wrap(a) for a in arrs]
+    if isinstance(template, (list, tuple)) or len(outs) > 1:
+        return outs
+    return outs[0]
+
+
+def _fn_on_arrays(func):
+    def f(*arrs):
+        outs = func(*[Tensor._wrap(a) for a in arrs])
+        if isinstance(outs, (list, tuple)):
+            return tuple(o._data for o in outs)
+        return outs._data
+    return f
+
+
+def jvp(func, xs, v=None):
+    """Forward-mode: returns (outputs, JVP) (reference
+    incubate/autograd/functional.py jvp)."""
+    arrs = _unwrap(xs)
+    tangents = _unwrap(v) if v is not None else \
+        [jnp.ones_like(a) for a in arrs]
+    out, tangent_out = jax.jvp(_fn_on_arrays(func), tuple(arrs),
+                               tuple(tangents))
+    single = not isinstance(out, tuple)
+    outs = (out,) if single else out
+    touts = (tangent_out,) if single else tangent_out
+    return (_wrap_like(outs, xs), _wrap_like(touts, xs))
+
+
+def vjp(func, xs, v=None):
+    """Reverse-mode: returns (outputs, VJP) (reference vjp)."""
+    arrs = _unwrap(xs)
+    out, vjp_fn = jax.vjp(_fn_on_arrays(func), *arrs)
+    single = not isinstance(out, tuple)
+    outs = (out,) if single else out
+    cotangents = _unwrap(v) if v is not None else \
+        [jnp.ones_like(o) for o in outs]
+    grads = vjp_fn(cotangents[0] if single else tuple(cotangents))
+    return (_wrap_like(outs, xs), _wrap_like(list(grads), xs))
+
+
+forward_grad = jvp
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    from paddle_tpu.autograd import grad as _g
+    return _g(outputs, inputs, grad_outputs)
+
+
+class Jacobian:
+    """Lazy row/col-sliceable Jacobian (reference
+    incubate/autograd/functional.py Jacobian)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        arrs = _unwrap(xs)
+        jac = jax.jacrev(_fn_on_arrays(func), argnums=tuple(
+            range(len(arrs))))(*arrs)
+        leaves = jax.tree_util.tree_leaves(jac)
+        self._jac = leaves[0] if len(leaves) == 1 else leaves
+        self._is_batched = is_batched
+
+    def __getitem__(self, idx):
+        j = self._jac if not isinstance(self._jac, list) else self._jac[0]
+        return Tensor._wrap(j[idx])
+
+    @property
+    def shape(self):
+        j = self._jac if not isinstance(self._jac, list) else self._jac[0]
+        return tuple(j.shape)
+
+
+class Hessian:
+    def __init__(self, func, xs, is_batched=False):
+        arrs = _unwrap(xs)
+        h = jax.hessian(_fn_on_arrays(func))(*arrs)
+        leaves = jax.tree_util.tree_leaves(h)
+        self._h = leaves[0] if len(leaves) == 1 else leaves
+
+    def __getitem__(self, idx):
+        h = self._h if not isinstance(self._h, list) else self._h[0]
+        return Tensor._wrap(h[idx])
+
+    @property
+    def shape(self):
+        h = self._h if not isinstance(self._h, list) else self._h[0]
+        return tuple(h.shape)
+
+
+def enable_prim():
+    from paddle_tpu.decomposition import enable_prim as ep
+    ep(True)
+
+
+def disable_prim():
+    from paddle_tpu.decomposition import enable_prim as ep
+    ep(False)
+
+
+def prim_enabled():
+    from paddle_tpu.decomposition import prim_enabled as pe
+    return pe()

@@ -1,0 +1,85 @@
+"""paddle.incubate.optimizer equivalent (reference:
+incubate/optimizer — LookAhead and ModelAverage wrappers)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """reference incubate/optimizer/lookahead.py: fast optimizer steps k
+    times, then slow weights interpolate toward fast weights."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = int(k)
+        self._step = 0
+        self._slow = {}
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step += 1
+        if self._step % self.k:
+            return
+        for p in self.inner_optimizer._parameter_list:
+            slow = self._slow.get(id(p))
+            if slow is None:
+                slow = p._data
+            slow = slow + self.alpha * (p._data - slow)
+            self._slow[id(p)] = slow
+            p._assign_array(slow)
+
+    def clear_grad(self, *a, **k):
+        self.inner_optimizer.clear_grad(*a, **k)
+
+    def minimize(self, loss):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_step"] = self._step
+        return sd
+
+
+class ModelAverage:
+    """reference incubate/optimizer/modelaverage.py: maintain a running
+    average of parameters; apply()/restore() swap it in and out for
+    evaluation."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._params = list(parameters or [])
+        self._rate = average_window_rate
+        self._min_w = min_average_window
+        self._max_w = max_average_window
+        self._sum = {id(p): jnp.zeros_like(p._data) for p in self._params}
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        for p in self._params:
+            self._sum[id(p)] = self._sum[id(p)] + p._data
+        self._count += 1
+
+    def apply(self, executor=None, need_restore=True):
+        if self._count == 0:
+            return
+        self._backup = {id(p): p._data for p in self._params}
+        for p in self._params:
+            p._assign_array((self._sum[id(p)] / self._count)
+                            .astype(p._data.dtype))
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._params:
+            p._assign_array(self._backup[id(p)])
+        self._backup = None

@@ -1,0 +1,88 @@
+"""paddle.nn.quant equivalent (reference: nn/quant — quantized layer
+building blocks used by the QAT/PTQ stack in paddle.quantization)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import run_op
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer.layers import Layer
+
+__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
+           "llm_int8_linear", "QuantizedLinear"]
+
+
+def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
+    """Per-channel symmetric int8 weight quantization (reference
+    nn/quant/quantized_linear.py weight_quantize)."""
+    def f(w):
+        scale = jnp.max(jnp.abs(w), axis=0) / 127.0
+        q = jnp.clip(jnp.round(w / jnp.maximum(scale, 1e-8)),
+                     -127, 127).astype(jnp.int8)
+        return q, scale.astype(jnp.float32)
+    return run_op("weight_quantize", f, x, n_outputs=2,
+                  differentiable=False)
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8",
+                      out_dtype="float16"):
+    def f(q, s):
+        return (q.astype(jnp.float32) * s).astype(
+            jnp.dtype(out_dtype.replace("paddle.", "")))
+    return run_op("weight_dequantize", f, x, scale,
+                  differentiable=False)
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1):
+    """int8-weight matmul: dequantize into the MXU's bf16 path
+    (reference weight_only_linear over cutlass kernels; XLA fuses the
+    dequant into the GEMM prologue on TPU)."""
+    def f(a, w, *rest):
+        i = 0
+        s = None
+        b = None
+        if weight_scale is not None:
+            s = rest[i]; i += 1
+        if bias is not None:
+            b = rest[i]
+        wf = w.astype(a.dtype)
+        if s is not None:
+            wf = wf * s.astype(a.dtype)
+        out = a @ wf
+        if b is not None:
+            out = out + b
+        return out
+    args = [x, weight]
+    if weight_scale is not None:
+        args.append(weight_scale)
+    if bias is not None:
+        args.append(bias)
+    return run_op("weight_only_linear", f, *args)
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0):
+    return weight_only_linear(x, weight, bias, weight_scale)
+
+
+class QuantizedLinear(Layer):
+    """Weight-only-int8 Linear (reference nn/quant quantized layers)."""
+
+    def __init__(self, in_features, out_features, bias=True,
+                 weight_dtype="int8"):
+        super().__init__()
+        import numpy as np
+        from paddle_tpu.core.tensor import Parameter
+        w = np.random.uniform(-0.05, 0.05,
+                              (in_features, out_features)).astype(
+            np.float32)
+        qw, scale = weight_quantize(Tensor(w))
+        self.quant_weight = qw
+        self.weight_scale = scale
+        self.bias = self.create_parameter(
+            [out_features], default_initializer=None) if bias else None
+
+    def forward(self, x):
+        return weight_only_linear(x, self.quant_weight, self.bias,
+                                  self.weight_scale)

@@ -1,0 +1,35 @@
+"""paddle.utils.dlpack equivalent (reference: utils/dlpack.py
+to_dlpack/from_dlpack over the C++ DLPack bridge). jax arrays speak
+DLPack natively — zero-copy on the same device."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Tensor -> DLPack provider (zero-copy view of the device buffer).
+
+    Returns the underlying jax Array, which implements the DLPack
+    protocol (__dlpack__/__dlpack_device__) — the modern capsule-free
+    interchange form every consumer (numpy/torch/jax from_dlpack)
+    accepts."""
+    if not isinstance(x, Tensor):
+        raise TypeError(
+            f"to_dlpack expects a paddle Tensor, got {type(x)}")
+    return x._data
+
+
+def from_dlpack(dlpack):
+    """DLPack provider (anything with __dlpack__) -> Tensor."""
+    if not hasattr(dlpack, "__dlpack__"):
+        raise TypeError(
+            "from_dlpack needs an object implementing the DLPack "
+            "protocol (__dlpack__/__dlpack_device__); pass the source "
+            "tensor/array itself rather than a raw capsule")
+    arr = jnp.from_dlpack(dlpack)
+    return Tensor._wrap(arr)

@@ -1,0 +1,57 @@
+"""paddle.utils.download equivalent (reference: utils/download.py —
+get_weights_path_from_url + cached archive handling). Zero-egress
+environment: resolves from the local cache
+(~/.cache/paddle/hapi/weights) and raises with the expected path when
+absent."""
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import tarfile
+import zipfile
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url"]
+
+WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle/hapi/weights")
+
+
+def _md5check(fullname, md5sum=None):
+    if md5sum is None:
+        return True
+    md5 = hashlib.md5()
+    with open(fullname, 'rb') as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            md5.update(chunk)
+    return md5.hexdigest() == md5sum
+
+
+def _decompress(fname, dirname):
+    if tarfile.is_tarfile(fname):
+        with tarfile.open(fname) as tf:
+            tf.extractall(dirname)
+    elif zipfile.is_zipfile(fname):
+        with zipfile.ZipFile(fname) as zf:
+            zf.extractall(dirname)
+    return dirname
+
+
+def get_path_from_url(url, root_dir, md5sum=None, check_exist=True,
+                      decompress=True):
+    fname = os.path.basename(url)
+    fullname = os.path.join(root_dir, fname)
+    if os.path.exists(fullname) and _md5check(fullname, md5sum):
+        if decompress and (tarfile.is_tarfile(fullname)
+                           or zipfile.is_zipfile(fullname)):
+            _decompress(fullname, root_dir)
+        return fullname
+    raise RuntimeError(
+        f"no network egress in this environment; place the file from "
+        f"{url} at {fullname}")
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    """reference download.py get_weights_path_from_url."""
+    os.makedirs(WEIGHTS_HOME, exist_ok=True)
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum,
+                             decompress=False)

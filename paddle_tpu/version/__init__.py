@@ -1,0 +1,54 @@
+"""paddle.version equivalent (reference: generated
+python/paddle/version/__init__.py)."""
+import jax
+
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+commit = "unknown"
+istaged = False
+with_pip = False
+
+cuda_version = "False"      # TPU build
+cudnn_version = "False"
+nccl_version = "0"
+xpu_version = "False"
+tensorrt_version = "None"
+cinn_version = "False"      # the compiler is XLA (see PARITY.md §2.5)
+
+
+def show():
+    print(f"paddle-tpu {full_version}")
+    print(f"jax {jax.__version__} (XLA backend)")
+    print("commit:", commit)
+    print("cuda: False (TPU-native build)")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version
+
+
+def nccl():
+    return nccl_version
+
+
+def xpu():
+    return xpu_version
+
+
+def xpu_xccl():
+    return "False"
+
+
+def xpu_xhpc():
+    return "False"
+
+
+def cinn():
+    return cinn_version

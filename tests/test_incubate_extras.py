@@ -1,0 +1,151 @@
+"""Tests for incubate (asp/autograd/optimizer), amp.debugging,
+nn.quant, utils.dlpack, distributed.utils MoE comm ops (reference
+analogs: test/asp, test/autograd, test/amp, test/quantization)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+class TestASP:
+    def test_prune_gives_2_4_density(self):
+        import paddle_tpu.incubate.asp as asp
+        lin = nn.Linear(16, 16)
+        asp.prune_model(lin)
+        assert abs(asp.calculate_density(lin.weight) - 0.5) < 0.01
+        # every group of 4 has exactly 2 nonzeros
+        w = lin.weight.numpy().reshape(-1, 4)
+        assert (np.count_nonzero(w, axis=1) <= 2).all()
+
+    def test_decorated_optimizer_preserves_masks(self):
+        import paddle_tpu.incubate.asp as asp
+        lin = nn.Linear(8, 8)
+        asp.prune_model(lin)
+        zero_mask = lin.weight.numpy() == 0
+        opt = asp.decorate(paddle.optimizer.SGD(
+            learning_rate=0.5, parameters=lin.parameters()))
+        x = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+        for _ in range(3):
+            loss = (lin(x) ** 2).mean()
+            opt.clear_grad()
+            loss.backward()
+            opt.step()
+        assert (lin.weight.numpy()[zero_mask] == 0).all()
+
+
+class TestIncubateAutograd:
+    def test_jvp_vjp(self):
+        import paddle_tpu.incubate.autograd as iag
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        _, tang = iag.jvp(lambda t: t * t, [x])
+        tg = tang[0] if isinstance(tang, list) else tang
+        np.testing.assert_allclose(tg.numpy(), [2.0, 4.0])
+        _, g = iag.vjp(lambda t: t * t, [x])
+        gg = g[0] if isinstance(g, list) else g
+        np.testing.assert_allclose(gg.numpy(), [2.0, 4.0])
+
+    def test_jacobian_hessian(self):
+        import paddle_tpu.incubate.autograd as iag
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        J = iag.Jacobian(lambda t: t * t, [x])
+        assert J.shape == (2, 2)
+        np.testing.assert_allclose(J[0].numpy(), [2.0, 0.0])
+        H = iag.Hessian(lambda t: (t * t).sum(), [x])
+        np.testing.assert_allclose(H[0].numpy(), [2.0, 0.0])
+
+
+class TestLookAheadModelAverage:
+    def test_lookahead_interpolates(self):
+        lin = nn.Linear(4, 4)
+        w0 = lin.weight.numpy().copy()
+        la = paddle.incubate.LookAhead(paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=lin.parameters()),
+            alpha=0.5, k=1)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        loss = (lin(x) ** 2).mean()
+        la.clear_grad()
+        loss.backward()
+        la.step()
+        # slow = w0 + 0.5*(fast - w0): strictly between w0 and fast
+        assert not np.allclose(lin.weight.numpy(), w0)
+
+    def test_model_average_apply_restore(self):
+        lin = nn.Linear(4, 4)
+        ma = paddle.incubate.ModelAverage(
+            0.15, parameters=lin.parameters())
+        w0 = lin.weight.numpy().copy()
+        ma.step()
+        lin.weight._assign_array(lin.weight._data * 3)
+        ma.step()
+        ma.apply()
+        np.testing.assert_allclose(lin.weight.numpy(), 2 * w0,
+                                   rtol=1e-5)
+        ma.restore()
+        np.testing.assert_allclose(lin.weight.numpy(), 3 * w0,
+                                   rtol=1e-5)
+
+
+class TestAmpDebugging:
+    def test_operator_stats(self, capsys):
+        import paddle_tpu.amp.debugging as dbg
+        with dbg.collect_operator_stats():
+            x = paddle.to_tensor(np.ones(4, np.float32))
+            _ = x + x
+        out = capsys.readouterr().out
+        assert "op list" in out and "float32" in out
+
+    def test_check_numerics_raises_on_nan(self):
+        import paddle_tpu.amp.debugging as dbg
+        with pytest.raises(RuntimeError):
+            dbg.check_numerics(
+                paddle.to_tensor(np.array([1.0, np.nan])), "op", "v")
+        assert dbg.check_numerics(
+            paddle.to_tensor(np.ones(3)), "op", "v") == (0, 0)
+
+
+class TestNnQuant:
+    def test_weight_quant_roundtrip(self):
+        import paddle_tpu.nn.quant as q
+        w = paddle.to_tensor(
+            np.random.RandomState(0).randn(16, 8).astype(np.float32))
+        qw, scale = q.weight_quantize(w)
+        assert qw.numpy().dtype == np.int8
+        deq = q.weight_dequantize(qw, scale, out_dtype="float32")
+        assert np.abs(deq.numpy() - w.numpy()).max() < 0.05
+
+    def test_weight_only_linear_matches_dense(self):
+        import paddle_tpu.nn.quant as q
+        rs = np.random.RandomState(1)
+        w = paddle.to_tensor(rs.randn(8, 4).astype(np.float32))
+        x = paddle.to_tensor(rs.randn(3, 8).astype(np.float32))
+        qw, scale = q.weight_quantize(w)
+        out = q.weight_only_linear(x, qw, weight_scale=scale)
+        ref = x.numpy() @ (qw.numpy().astype(np.float32)
+                           * scale.numpy())
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4,
+                                   atol=1e-4)
+
+
+class TestDlpack:
+    def test_roundtrip_and_torch_interop(self):
+        from paddle_tpu.utils.dlpack import from_dlpack, to_dlpack
+        t = paddle.to_tensor(np.arange(6, dtype=np.float32))
+        t2 = from_dlpack(to_dlpack(t))
+        np.testing.assert_allclose(t2.numpy(), t.numpy())
+        import torch
+        tt = torch.arange(4, dtype=torch.float32)
+        np.testing.assert_allclose(from_dlpack(tt).numpy(),
+                                   [0, 1, 2, 3])
+
+
+class TestMoeCommOps:
+    def test_global_scatter_gather_roundtrip(self):
+        from paddle_tpu.distributed.utils import (global_gather,
+                                                  global_scatter)
+        x = paddle.to_tensor(
+            np.arange(12, dtype=np.float32).reshape(6, 2))
+        counts = paddle.to_tensor(np.array([2, 1, 3]))
+        s = global_scatter(x, counts, counts)
+        back = global_gather(s, counts, counts)
+        np.testing.assert_allclose(back.numpy(), x.numpy())
