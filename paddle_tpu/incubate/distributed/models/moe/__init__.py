@@ -1,0 +1,9 @@
+"""paddle.incubate.distributed.models.moe namespace (reference:
+incubate/distributed/models/moe/moe_layer.py:263 MoELayer + gate zoo;
+implementation lives in paddle_tpu.models.moe — expert-parallel via
+all-to-all over the dp axis, SURVEY §2.7 EP row)."""
+from paddle_tpu.models.moe import (  # noqa: F401
+    ExpertFFN, MoELayer, MoETransformerBlock, TopKGate,
+)
+from paddle_tpu.models.moe import TopKGate as GShardGate  # noqa: F401
+from paddle_tpu.models.moe import TopKGate as SwitchGate  # noqa: F401

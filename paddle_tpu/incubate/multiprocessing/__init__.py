@@ -1,0 +1,25 @@
+"""paddle.incubate.multiprocessing equivalent (reference:
+incubate/multiprocessing — mp with tensor-aware pickling over shared
+memory). Device arrays pickle via host copies here (TPU HBM is not
+process-sharable); the API shape is python multiprocessing's."""
+from multiprocessing import *  # noqa: F401,F403
+from multiprocessing import get_context, Process, Queue, Pipe  # noqa: F401
+
+import copyreg
+
+import numpy as np
+
+
+def _reduce_tensor(t):
+    """Pickle a Tensor as its host numpy copy (reference uses shared
+    memory; cross-process device handles don't exist for TPU)."""
+    from paddle_tpu.core.tensor import Tensor
+    return (Tensor, (t.numpy(),))
+
+
+def _install():
+    from paddle_tpu.core.tensor import Tensor
+    copyreg.pickle(Tensor, _reduce_tensor)
+
+
+_install()
