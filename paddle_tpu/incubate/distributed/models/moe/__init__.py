@@ -6,4 +6,12 @@ from paddle_tpu.models.moe import (  # noqa: F401
     ExpertFFN, MoELayer, MoETransformerBlock, TopKGate,
 )
 from paddle_tpu.models.moe import TopKGate as GShardGate  # noqa: F401
-from paddle_tpu.models.moe import TopKGate as SwitchGate  # noqa: F401
+
+
+class SwitchGate(TopKGate):
+    """Switch routing is top-1 by definition (reference
+    moe/gate/switch_gate.py)."""
+
+    def __init__(self, hidden_size, num_experts, top_k=1,
+                 capacity_factor=1.25):
+        super().__init__(hidden_size, num_experts, 1, capacity_factor)

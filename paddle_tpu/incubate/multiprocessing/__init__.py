@@ -10,11 +10,20 @@ import copyreg
 import numpy as np
 
 
-def _reduce_tensor(t):
-    """Pickle a Tensor as its host numpy copy (reference uses shared
-    memory; cross-process device handles don't exist for TPU)."""
+def _rebuild_tensor(arr, stop_gradient, name):
     from paddle_tpu.core.tensor import Tensor
-    return (Tensor, (t.numpy(),))
+    t = Tensor(arr, stop_gradient=stop_gradient)
+    if name is not None:
+        t.name = name
+    return t
+
+
+def _reduce_tensor(t):
+    """Pickle a Tensor as its host numpy copy, preserving
+    stop_gradient and name (reference uses shared memory;
+    cross-process device handles don't exist for TPU)."""
+    return (_rebuild_tensor,
+            (t.numpy(), t.stop_gradient, getattr(t, "name", None)))
 
 
 def _install():
