@@ -1,11 +1,16 @@
 """paddle.io equivalent: Dataset / DataLoader / samplers (reference:
 python/paddle/io/reader.py:262, io/dataloader/dataloader_iter.py:155,370).
 
-Round-1 design: in-process iterator with background-thread prefetch to
-device (the reference's multiprocess shared-mem workers + C++
-LoDTensorBlockingQueue become a thread + queue here; a native C++ loader is
-the planned upgrade — TPU input pipelines are host-CPU bound, not
-GIL-bound, for tensor collation via numpy).
+Loading paths, mirroring the reference's single/multi-process split:
+- num_workers 0/1: in-process iterator, optional background-thread
+  prefetch (the C++ LoDTensorBlockingQueue role).
+- num_workers > 1 (map-style): forked worker processes pull index
+  batches and collate to numpy; the parent reorders for sampler
+  determinism and re-wraps on device. Workers are deliberately
+  jax-free (the XLA runtime is fork-unsafe), so items cross as numpy —
+  the reference's shared-memory discipline, pickled here.
+Native C++ helpers (paddle_tpu.native): threaded collate +
+uint8-HWC→f32-CHW batch transform feed the same pipeline.
 """
 from __future__ import annotations
 
@@ -308,6 +313,8 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.use_buffer_reader = use_buffer_reader
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
         self._iterable_mode = isinstance(dataset, IterableDataset)
         self.batch_size = batch_size
         self.drop_last = drop_last
@@ -354,6 +361,10 @@ class DataLoader:
         if not self.use_buffer_reader or self.num_workers == 0:
             yield from self._produce()
             return
+        if not self._iterable_mode and self.batch_sampler is not None \
+                and self.num_workers > 1:
+            yield from self._iter_multiprocess()
+            return
         # background-thread prefetch (buffered reader / blocking-queue role)
         q: "queue.Queue" = queue.Queue(
             maxsize=max(2, self.prefetch_factor * max(self.num_workers, 1)))
@@ -374,6 +385,138 @@ class DataLoader:
                 break
             yield item
 
+    # ----------------------------------------------------------------
+    # True multi-process loading (reference
+    # io/dataloader/dataloader_iter.py:370 _DataLoaderIterMultiProcess:
+    # worker processes pull index batches, collate to numpy, push
+    # results; the parent reorders to keep sampler determinism).
+    # ----------------------------------------------------------------
+    def _iter_multiprocess(self):
+        import multiprocessing as mp
+        ctx = mp.get_context("fork")
+        n = self.num_workers
+        idx_queues = [ctx.Queue() for _ in range(n)]
+        out_q = ctx.Queue(maxsize=max(2, self.prefetch_factor * n))
+        timeout = self.timeout if getattr(self, "timeout", 0) else 120
+
+        procs = [ctx.Process(
+            target=_worker_loop,
+            args=(self.dataset, self.collate_fn, idx_queues[w], out_q,
+                  w, n, self.worker_init_fn),
+            daemon=True) for w in range(n)]
+        for p in procs:
+            p.start()
+        try:
+            batches = list(self.batch_sampler)
+            for seq, b in enumerate(batches):
+                idx_queues[seq % n].put((seq, list(b)))
+            for iq in idx_queues:
+                iq.put(None)
+            pending = {}
+            want = 0
+            got = 0
+            while got < len(batches):
+                if want in pending:
+                    item = pending.pop(want)
+                else:
+                    seq, payload = out_q.get(timeout=timeout)
+                    if seq == -1:
+                        raise RuntimeError(
+                            f"DataLoader worker failed: {payload}")
+                    if seq != want:
+                        pending[seq] = payload
+                        continue
+                    item = payload
+                got += 1
+                want += 1
+                yield _rewrap(item)
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            for p in procs:
+                p.join(timeout=5)
+
+
+class WorkerInfo:
+    """reference io/dataloader/worker.py WorkerInfo."""
+
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info: Optional[WorkerInfo] = None
+
+
+def _unwrap(item):
+    """Tensor -> numpy for the queue (device handles don't cross
+    processes)."""
+    if isinstance(item, Tensor):
+        return ("__t__", item.numpy())
+    if isinstance(item, (list, tuple)):
+        return type(item)(_unwrap(i) for i in item)
+    if isinstance(item, dict):
+        return {k: _unwrap(v) for k, v in item.items()}
+    return item
+
+
+def _rewrap(item):
+    if isinstance(item, tuple) and len(item) == 2 and item[0] == "__t__":
+        return Tensor(item[1])
+    if isinstance(item, (list, tuple)):
+        return type(item)(_rewrap(i) for i in item)
+    if isinstance(item, dict):
+        return {k: _rewrap(v) for k, v in item.items()}
+    return item
+
+
+def _np_collate(batch):
+    """numpy-only collate for worker processes — forked children must
+    never touch the (fork-unsafe) jax runtime; the parent re-wraps."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return ("__t__", np.stack(batch))
+    if isinstance(sample, (int, np.integer)):
+        return ("__t__", np.asarray(batch, np.int64))
+    if isinstance(sample, (float, np.floating)):
+        return ("__t__", np.asarray(batch, np.float32))
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: _np_collate([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, (tuple, list)):
+        return [_np_collate(list(items)) for items in zip(*batch)]
+    raise TypeError(f"cannot collate {type(sample)}")
+
+
+def _worker_loop(dataset, collate_fn, idx_q, out_q, worker_id,
+                 num_workers, worker_init_fn):
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset)
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    np_mode = collate_fn is default_collate_fn
+    try:
+        while True:
+            job = idx_q.get()
+            if job is None:
+                break
+            seq, indices = job
+            items = [dataset[i] for i in indices]
+            if np_mode:
+                out = _np_collate(items)
+            else:
+                # custom collate: must stay numpy-only in workers (the
+                # jax runtime is fork-unsafe); Tensors are unwrapped
+                out = _unwrap(collate_fn(items))
+            out_q.put((seq, out))
+    except Exception as e:  # surface the error to the parent
+        out_q.put((-1, f"worker {worker_id}: {e!r}"))
+
 
 def get_worker_info():
-    return None
+    """Inside a worker process: (id, num_workers, dataset); None in the
+    main process (reference io/dataloader/worker.py get_worker_info)."""
+    return _worker_info
