@@ -56,6 +56,8 @@ from . import checkpoint  # noqa: F401
 from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 from . import launch  # noqa: F401
 from . import rpc  # noqa: F401
+from . import communication  # noqa: F401
+from .communication import stream  # noqa: F401
 from . import ps  # noqa: F401
 from . import fleet_executor  # noqa: F401
 from .collective import gather, scatter_object_list  # noqa: F401

@@ -1,0 +1,10 @@
+"""paddle.distributed.communication namespace (reference:
+python/paddle/distributed/communication/ — the group ops live in
+..collective here; this package adds the stream.* variants)."""
+from ..collective import (  # noqa: F401
+    all_gather, all_gather_object, all_reduce, alltoall, alltoall_single,
+    batch_isend_irecv, broadcast, broadcast_object_list, gather, irecv,
+    isend, P2POp, recv, reduce, reduce_scatter, ReduceOp, scatter,
+    scatter_object_list, send, wait,
+)
+from . import stream  # noqa: F401

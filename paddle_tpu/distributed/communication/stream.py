@@ -1,0 +1,74 @@
+"""paddle.distributed.communication.stream equivalent (reference:
+communication/stream/*.py — collectives issued on an explicit comm
+stream, returning async Tasks).
+
+TPU framing: XLA owns stream ordering; `use_calc_stream` has no
+hardware meaning, so every stream.* op is the plain collective with an
+async-looking Task handle (SURVEY §2.6 — the async Task/stream
+semantics collapse into XLA's async collectives)."""
+from __future__ import annotations
+
+from .. import collective as _c
+
+__all__ = ["all_reduce", "all_gather", "alltoall", "alltoall_single",
+           "broadcast", "reduce", "reduce_scatter", "scatter", "send",
+           "recv"]
+
+
+def _task(result=None):
+    return _c._Task(result)
+
+
+def all_reduce(tensor, op=_c.ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=False):
+    return _c.all_reduce(tensor, op, group, sync_op=True)
+
+
+def all_gather(tensor_or_tensor_list, tensor, group=None, sync_op=True,
+               use_calc_stream=False):
+    return _c.all_gather(tensor_or_tensor_list, tensor, group,
+                         sync_op=True)
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True,
+             use_calc_stream=False):
+    # NOTE reference stream.alltoall takes (out, in)
+    return _c.alltoall(in_tensor_list, out_tensor_list, group,
+                       sync_op=True)
+
+
+def alltoall_single(out_tensor, in_tensor, out_split_sizes=None,
+                    in_split_sizes=None, group=None, sync_op=True,
+                    use_calc_stream=False):
+    return _c.alltoall_single(in_tensor, out_tensor, in_split_sizes,
+                              out_split_sizes, group, sync_op=True)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True,
+              use_calc_stream=False):
+    return _c.broadcast(tensor, src, group, sync_op=True)
+
+
+def reduce(tensor, dst=0, op=_c.ReduceOp.SUM, group=None, sync_op=True,
+           use_calc_stream=False):
+    return _c.reduce(tensor, dst, op, group, sync_op=True)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=_c.ReduceOp.SUM,
+                   group=None, sync_op=True, use_calc_stream=False):
+    return _c.reduce_scatter(tensor, tensor_or_tensor_list, op, group,
+                             sync_op=True)
+
+
+def scatter(tensor, tensor_or_tensor_list=None, src=0, group=None,
+            sync_op=True, use_calc_stream=False):
+    return _c.scatter(tensor, tensor_or_tensor_list, src, group,
+                      sync_op=True)
+
+
+def send(tensor, dst=0, group=None, sync_op=True, use_calc_stream=False):
+    return _c.send(tensor, dst, group, sync_op=True)
+
+
+def recv(tensor, src=0, group=None, sync_op=True, use_calc_stream=False):
+    return _c.recv(tensor, src, group, sync_op=True)
