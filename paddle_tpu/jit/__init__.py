@@ -127,6 +127,8 @@ class StaticFunction:
         return _state_tensors(layers, opts, scalers)
 
     def __call__(self, *args, **kwargs):
+        if getattr(self, "_fallback_eager", False):
+            return self._fn(*args, **kwargs)
         state = self._resolve_state()
         gen = gen_mod.default_generator()
         arg_arrays, meta = _tree_flatten_args(args, kwargs)
@@ -140,8 +142,22 @@ class StaticFunction:
 
         state_arrays = [t._data for t in state]
         key_in = gen._key
-        out_arrays, new_state, new_key = jitted(
-            state_arrays, key_in, arg_arrays)
+        try:
+            out_arrays, new_state, new_key = jitted(
+                state_arrays, key_in, arg_arrays)
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.ConcretizationTypeError,
+                jax.errors.TracerArrayConversionError) as e:
+            # graph break (reference SOT: untraceable python control
+            # flow falls back to eager; here at function granularity)
+            import warnings
+            warnings.warn(
+                f"to_static: {self._fn.__qualname__} is not traceable "
+                f"({type(e).__name__}); falling back to eager "
+                f"execution", stacklevel=2)
+            self._fallback_eager = True
+            self._cache.pop(key, None)
+            return self._fn(*args, **kwargs)
         for t, a in zip(state, new_state):
             t._data = a
         gen._key = new_key

@@ -211,3 +211,31 @@ class TestCallbacks:
         lines = [json.loads(ln) for ln in
                  open(tmp_path / "scalars.jsonl")]
         assert lines[0]["tag"] == "train/loss"
+
+
+class TestGraphBreakFallback:
+    def test_untraceable_fn_falls_back_to_eager(self):
+        import warnings
+        from paddle_tpu import nn
+        lin = nn.Linear(4, 4)
+
+        def untraceable(x):
+            if float(x.sum().numpy()) > 0:   # data-dependent branch
+                return lin(x) * 2
+            return lin(x)
+
+        f = paddle.jit.to_static(untraceable, objs=[lin])
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            out = f(x)
+        assert any("falling back to eager" in str(m.message) for m in w)
+        np.testing.assert_allclose(out.numpy(), f(x).numpy())
+
+    def test_traceable_fn_still_compiles(self):
+        from paddle_tpu import nn
+        lin = nn.Linear(4, 4)
+        g = paddle.jit.to_static(lambda x: lin(x) + 1, objs=[lin])
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        np.testing.assert_allclose(g(x).numpy(), lin(x).numpy() + 1,
+                                   rtol=1e-5)
