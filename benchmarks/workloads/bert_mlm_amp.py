@@ -1,0 +1,70 @@
+"""Workload 2 (BASELINE.json configs): BERT-base MLM fine-tune under
+AMP O2 with GradScaler (reference: paddle.nn.TransformerEncoder + amp).
+"""
+import sys
+import os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import argparse
+import time
+
+import numpy as np
+
+
+def main(smoke=True, steps=10):
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.models.bert import BertConfig, BertForMaskedLM
+
+    cfg = BertConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                     num_heads=4, intermediate_size=128,
+                     max_seq_len=64) if smoke else BertConfig()
+    model = BertForMaskedLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3 if smoke else 5e-5,
+                                 parameters=model.parameters(),
+                                 multi_precision=True)
+    # AMP O2: bf16 weights with fp32 master weights via decorate
+    model, opt = paddle.amp.decorate(models=model, optimizers=opt,
+                                     level="O2",
+                                     dtype="bfloat16")
+    scaler = paddle.amp.GradScaler(init_loss_scaling=2.0 ** 10)
+    lossf = nn.CrossEntropyLoss()
+
+    rng = np.random.RandomState(0)
+    B, S = (4, 32) if smoke else (32, 128)
+    fixed = rng.randint(0, cfg.vocab_size, (B, S))
+    losses = []
+    t0 = time.time()
+    for step in range(steps):
+        # smoke memorizes one batch so the loss-decrease assert is
+        # meaningful; full mode streams fresh data
+        ids = fixed.copy() if smoke else rng.randint(
+            0, cfg.vocab_size, (B, S))
+        labels = ids.copy()
+        mask = rng.rand(B, S) < 0.15
+        ids[mask] = 0                         # [MASK]
+        xb = paddle.to_tensor(ids)
+        yb = paddle.to_tensor(labels)
+        with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+            logits = model(xb)
+            loss = lossf(logits.reshape([-1, cfg.vocab_size]),
+                         yb.reshape([-1]))
+        opt.clear_grad()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.step(opt)
+        scaler.update()
+        losses.append(float(loss.numpy()))
+    dt = time.time() - t0
+    print(f"bert_mlm_amp_o2: loss {losses[0]:.3f}->{losses[-1]:.3f} "
+          f"({steps / dt:.2f} steps/s)")
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+    return losses
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--steps", type=int, default=10)
+    a = ap.parse_args()
+    main(a.smoke, a.steps)

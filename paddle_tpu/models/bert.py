@@ -41,6 +41,11 @@ class BertEmbeddings(nn.Layer):
                                                 cfg.hidden_size)
         self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
                                                   cfg.hidden_size)
+        # BERT initializer_range=0.02 (tied LM head needs small-std
+        # embeddings or initial logits blow up to std sqrt(h))
+        for emb in (self.word_embeddings, self.position_embeddings,
+                    self.token_type_embeddings):
+            emb.weight._assign_array(emb.weight._data * 0.02)
         self.layer_norm = nn.LayerNorm(cfg.hidden_size, epsilon=1e-12)
         self.dropout = nn.Dropout(cfg.dropout)
 

@@ -1,0 +1,45 @@
+"""Smoke-run the five BASELINE.json workload configs (reference
+capability matrix: ResNet/CIFAR dygraph, BERT MLM AMP-O2, GPT
+DP+sharding-1, Llama TP4xPP2, MoE expert-parallel) on the 8-device CPU
+mesh."""
+import importlib.util
+import os
+import sys
+
+import pytest
+
+_DIR = os.path.join(os.path.dirname(__file__), "..", "benchmarks",
+                    "workloads")
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        f"workload_{name}", os.path.join(_DIR, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_resnet_cifar10_dygraph():
+    losses = _load("resnet50_cifar10").main(smoke=True, steps=6)
+    assert len(losses) == 6
+
+
+def test_bert_mlm_amp_o2():
+    losses = _load("bert_mlm_amp").main(smoke=True, steps=6)
+    assert losses[-1] < losses[0]
+
+
+def test_gpt_dp_sharding1():
+    losses = _load("gpt_dp_sharding1").main(smoke=True, steps=4)
+    assert losses[-1] < losses[0]
+
+
+def test_llama_tp_pp():
+    losses = _load("llama_tp_pp_sharding3").main(smoke=True, steps=3)
+    assert losses[-1] < losses[0]
+
+
+def test_moe_expert_parallel():
+    losses = _load("moe_ep").main(smoke=True, steps=4)
+    assert losses[-1] < losses[0]
