@@ -37,6 +37,9 @@ def main():
     log_dir = args.log_dir
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
+    host = master.rsplit(":", 1)[0]
+    base_port = int(master.rsplit(":", 1)[1]) + 1
+    endpoints = ",".join(f"{host}:{base_port + r}" for r in range(world))
     for local in range(nprocs):
         rank = args.rank * nprocs + local
         env = dict(os.environ)
@@ -44,8 +47,12 @@ def main():
             "PADDLE_TRAINER_ID": str(rank),
             "PADDLE_TRAINERS_NUM": str(world),
             "PADDLE_MASTER": master,
+            "PADDLE_MASTER_ENDPOINT": master,
             "PADDLE_LOCAL_RANK": str(local),
             "PADDLE_JOB_ID": args.job_id,
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_CURRENT_ENDPOINT":
+                f"{host}:{base_port + rank}",
         })
         cmd = [sys.executable, args.script] + args.script_args
         stdout = open(os.path.join(log_dir, f"worker.{rank}.log"), "w") \
