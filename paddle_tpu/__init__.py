@@ -167,3 +167,12 @@ def summary(net, input_size=None, dtypes=None, input=None):
         if not p.stop_gradient:
             trainable += n
     return {"total_params": total, "trainable_params": trainable}
+
+
+# bind the rest of the reference Tensor-method surface: every method in
+# the reference tensor_method_func list whose op exists at module level
+# becomes a Tensor method (the reference's monkey-patch pass,
+# python/paddle/tensor/__init__.py)
+from paddle_tpu.ops.tensor_methods import patch_from_modules as _pfm
+_pfm()
+del _pfm

@@ -546,6 +546,7 @@ _INPLACE_NAMES = [
     # linalg / misc
     "addmm", "renorm", "polygamma", "multigammaln", "sinc",
     "gammainc", "gammaincc", "gammaln",
+    "lerp", "put_along_axis", "transpose",
 ]
 
 

@@ -206,3 +206,11 @@ def one_hot(x, num_classes, name=None):
                   lambda a: jax.nn.one_hot(
                       a, num_classes, dtype=dtype_mod.get_default_dtype()),
                   x, differentiable=False)
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """reference tensor/creation.py:265 — an empty typed Tensor
+    placeholder (legacy static helper)."""
+    from paddle_tpu.core import dtype as dtype_mod
+    from paddle_tpu.core.tensor import Tensor
+    return Tensor(np.zeros((), dtype_mod.convert_dtype(dtype)))

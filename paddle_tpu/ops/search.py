@@ -248,3 +248,34 @@ def bincount(x, weights=None, minlength=0, name=None):
     return run_op("bincount",
                   lambda a: jnp.bincount(a, minlength=minlength, length=n),
                   x, differentiable=False)
+
+
+def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1, k=0,
+                   mode="truncated", return_top=False, name=None):
+    """Nucleus (top-p) sampling per row (reference tensor/search.py:1362
+    over the top_p_sampling CUDA kernel; XLA sort + cumsum + categorical
+    draw on TPU)."""
+    from paddle_tpu.core.generator import default_generator
+
+    key = jax.random.PRNGKey(seed) if seed >= 0 else \
+        default_generator().next_key()
+
+    def f(logits, p):
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        order = jnp.argsort(-probs, axis=-1)
+        sorted_p = jnp.take_along_axis(probs, order, axis=-1)
+        cum = jnp.cumsum(sorted_p, axis=-1)
+        # keep tokens while cumulative mass (exclusive) < p
+        keep = (cum - sorted_p) < p.reshape(-1, 1).astype(jnp.float32)
+        keep = keep.at[:, 0].set(True)
+        masked = jnp.where(keep, sorted_p, 0.0)
+        masked = masked / jnp.sum(masked, axis=-1, keepdims=True)
+        draw = jax.random.categorical(key, jnp.log(
+            jnp.maximum(masked, 1e-38)), axis=-1)
+        ids = jnp.take_along_axis(order, draw[:, None], axis=-1)
+        scores = jnp.take_along_axis(probs, ids, axis=-1)
+        return scores.astype(logits.dtype), ids.astype(jnp.int64)
+
+    out = run_op("top_p_sampling", f, x, ps, n_outputs=2,
+                 differentiable=False)
+    return out

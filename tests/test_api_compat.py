@@ -145,3 +145,42 @@ def test_framework_helpers():
     with paddle.LazyGuard():
         pass
     assert paddle.flops(paddle.nn.Linear(4, 8), [2, 4]) > 0
+
+
+class TestTensorMethodSurface:
+    def test_reference_method_list_fully_bound(self):
+        """Every method in the reference's tensor_method_func list
+        (python/paddle/tensor/__init__.py) exists on Tensor."""
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.ops.tensor_methods import (
+            REFERENCE_TENSOR_METHODS)
+        missing = [m for m in REFERENCE_TENSOR_METHODS
+                   if not hasattr(Tensor, m)]
+        assert missing == [], missing
+
+    def test_patched_methods_route_self_first(self):
+        t = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]],
+                                      np.float32))
+        np.testing.assert_allclose(
+            t.cdist(t).numpy(),
+            [[0, np.sqrt(8)], [np.sqrt(8), 0]], rtol=1e-5, atol=1e-6)
+        a = paddle.to_tensor(np.zeros(3, np.float32))
+        a.lerp_(paddle.to_tensor(np.ones(3, np.float32)), 0.5)
+        np.testing.assert_allclose(a.numpy(), 0.5)
+        tr = paddle.to_tensor(np.arange(6, dtype=np.float32)
+                              .reshape(2, 3))
+        tr.transpose_([1, 0])
+        assert tr.shape == [3, 2]
+
+    def test_top_p_sampling(self):
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(2, 16).astype(np.float32))
+        ps = paddle.to_tensor(np.array([0.9, 0.5], np.float32))
+        scores, ids = paddle.top_p_sampling(x, ps)
+        assert ids.numpy().shape == (2, 1)
+        assert (ids.numpy() >= 0).all() and (ids.numpy() < 16).all()
+        # p -> 0 degenerates to argmax
+        ps0 = paddle.to_tensor(np.array([1e-6, 1e-6], np.float32))
+        _, ids0 = paddle.top_p_sampling(x, ps0)
+        np.testing.assert_array_equal(
+            ids0.numpy().ravel(), x.numpy().argmax(-1))
