@@ -226,3 +226,112 @@ def variable_length_memory_efficient_attention(query, key, value,
 def fused_multi_head_attention(x, qkv_weight, linear_weight, **kw):
     raise NotImplementedError(
         "use paddle_tpu.nn.MultiHeadAttention (XLA fuses the projections)")
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False,
+                      transpose_y=False, name=None):
+    """reference incubate fused_matmul_bias (cublasLt epilogue); XLA
+    fuses the bias add into the GEMM on TPU."""
+    import jax.numpy as jnp
+    from paddle_tpu.core.dispatch import run_op
+
+    def f(a, b, *rest):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = a @ b
+        if rest:
+            out = out + rest[0]
+        return out
+    args = (x, y) + ((bias,) if bias is not None else ())
+    return run_op("fused_matmul_bias", f, *args)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, mode=
+                      'upscale_in_train', ring_id=-1, name=None):
+    """reference incubate fused_feedforward (fused FFN kernel): the
+    pre/post-LN transformer FFN block as one XLA-fused graph."""
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.ops.linalg import matmul
+
+    def ln(v, scale, bias, eps):
+        return F.layer_norm(v, [v.shape[-1]], weight=scale, bias=bias,
+                            epsilon=eps)
+
+    residual = x
+    if pre_layer_norm:
+        x = ln(x, ln1_scale, ln1_bias, ln1_epsilon)
+    h = matmul(x, linear1_weight)
+    if linear1_bias is not None:
+        h = h + linear1_bias
+    h = getattr(F, activation)(h)
+    h = F.dropout(h, p=dropout1_rate, training=training, mode=mode)
+    h = matmul(h, linear2_weight)
+    if linear2_bias is not None:
+        h = h + linear2_bias
+    h = F.dropout(h, p=dropout2_rate, training=training, mode=mode)
+    out = residual + h
+    if not pre_layer_norm:
+        out = ln(out, ln2_scale, ln2_bias, ln2_epsilon)
+    return out
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode='upscale_in_train', name=None):
+    """reference incubate fused_bias_dropout_residual_layer_norm."""
+    import paddle_tpu.nn.functional as F
+    h = x if bias is None else x + bias
+    h = F.dropout(h, p=dropout_rate, training=training, mode=mode)
+    out = residual + h
+    return F.layer_norm(out, [out.shape[-1]], weight=ln_scale,
+                        bias=ln_bias, epsilon=ln_epsilon)
+
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size,
+                     name=None):
+    """reference blha_get_max_len (block-attention helper): max
+    encoder/decoder sequence lengths for kernel dispatch."""
+    import jax.numpy as jnp
+    from paddle_tpu.core.dispatch import run_op
+
+    def f(enc, dec):
+        return jnp.max(enc), jnp.max(dec)
+    return run_op("blha_get_max_len", f, seq_lens_encoder,
+                  seq_lens_decoder, n_outputs=2, differentiable=False)
+
+
+def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
+                              seq_lens_decoder, seq_lens_this_time,
+                              padding_offsets=None, cum_offsets=None,
+                              cu_seqlens_q=None, cu_seqlens_k=None,
+                              block_tables=None, **kwargs):
+    """reference incubate block_multihead_attention (paged-KV inference
+    attention). The paged-block layout is a GPU memory-management
+    device; on TPU the cache lives as dense [B, S, H, D] arrays and XLA
+    attention reads it directly — use
+    paddle_tpu.nn.functional.scaled_dot_product_attention with a cache,
+    or models/gpt.py's decode path."""
+    raise NotImplementedError(
+        "paged/block KV attention is a GPU memory-layout construct; on "
+        "TPU use nn.functional.scaled_dot_product_attention over dense "
+        "KV caches (models/*.py generate() paths)")
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights,
+                            qkv_biases, *args, **kwargs):
+    """reference incubate fused_multi_transformer (single-kernel
+    multi-layer inference transformer). The XLA analog is compiling the
+    whole decode step with paddle_tpu.jit.to_static — one fused
+    program; see models/gpt.py."""
+    raise NotImplementedError(
+        "compile the full decode step with paddle_tpu.jit.to_static "
+        "instead: XLA produces the one fused program this kernel "
+        "hand-writes on GPU")

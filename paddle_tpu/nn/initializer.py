@@ -180,6 +180,25 @@ class Dirac(Initializer):
         return jnp.asarray(w, dtype_mod.convert_dtype(dtype))
 
 
+class Bilinear(Initializer):
+    """Bilinear-interpolation kernel init for transposed-conv upsampling
+    (reference nn/initializer/Bilinear)."""
+
+    def __call__(self, shape, dtype):
+        if len(shape) != 4:
+            raise ValueError("Bilinear init expects a 4-D conv weight")
+        k = shape[-1]
+        factor = (k + 1) // 2
+        center = factor - 1 if k % 2 == 1 else factor - 0.5
+        og = np.ogrid[:k, :k]
+        filt = ((1 - np.abs(og[0] - center) / factor)
+                * (1 - np.abs(og[1] - center) / factor))
+        w = np.zeros(shape, np.float32)
+        for i in range(min(shape[0], shape[1])):
+            w[i, i] = filt
+        return jnp.asarray(w, dtype_mod.convert_dtype(dtype))
+
+
 # default initializer used by layers when weight_attr is None
 _GLOBAL_DEFAULT = XavierUniform()
 

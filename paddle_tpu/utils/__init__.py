@@ -50,3 +50,23 @@ def pack_sequence_as(structure, flat):
     import jax
     treedef = jax.tree_util.tree_structure(structure)
     return jax.tree_util.tree_unflatten(treedef, flat)
+
+
+def require_version(min_version, max_version=None):
+    """reference utils/__init__ require_version: validate the installed
+    framework version is within range."""
+    from paddle_tpu.version import full_version
+
+    def parse(v):
+        return tuple(int(p) for p in str(v).split(".")[:3] if p.isdigit())
+
+    cur = parse(full_version)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"paddle_tpu version {full_version} < required "
+            f"{min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"paddle_tpu version {full_version} > allowed "
+            f"{max_version}")
+    return True
