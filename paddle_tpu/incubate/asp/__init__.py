@@ -17,7 +17,6 @@ __all__ = ["calculate_density", "decorate", "prune_model",
            "set_excluded_layers", "reset_excluded_layers",
            "OptimizerWithSparsityGuarantee"]
 
-_masks: Dict[int, np.ndarray] = {}
 _excluded: Dict[int, List[str]] = {}
 
 
@@ -63,7 +62,9 @@ def _prunable(layer, p):
 
 def prune_model(model, n=2, m=4, mask_algo='mask_1d', with_mask=True):
     """Apply 2:4 magnitude masks to every prunable weight (reference
-    asp.py:319)."""
+    asp.py:319). The mask is stored ON the parameter (`p._asp_mask`) —
+    an id()-keyed registry would mis-apply stale masks when python
+    recycles object ids across models."""
     masks = {}
     for layer in model.sublayers(include_self=True):
         w = getattr(layer, "weight", None)
@@ -72,8 +73,8 @@ def prune_model(model, n=2, m=4, mask_algo='mask_1d', with_mask=True):
         wn = np.asarray(w._data, np.float32)
         mask = _mask_2_4(wn)
         w._assign_array(jnp.asarray(wn * mask, w._data.dtype))
+        w._asp_mask = mask
         masks[id(w)] = mask
-        _masks[id(w)] = mask
     return masks
 
 
@@ -90,7 +91,7 @@ class OptimizerWithSparsityGuarantee:
     def step(self, *args, **kwargs):
         out = self._optimizer.step(*args, **kwargs)
         for p in self._optimizer._parameter_list:
-            mask = _masks.get(id(p))
+            mask = getattr(p, "_asp_mask", None)
             if mask is not None:
                 p._assign_array(p._data * jnp.asarray(mask,
                                                       p._data.dtype))
