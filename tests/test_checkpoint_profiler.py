@@ -99,3 +99,38 @@ def test_launcher_cpu_sim(tmp_path):
     assert res.returncode == 0, res.stderr
     out = res.stdout
     assert "rank 0 world 2" in out and "rank 1 world 2" in out
+
+
+def test_checkpoint_reshards_across_mesh_change(tmp_path):
+    """Save sharded over one mesh layout, load into a DIFFERENT layout
+    (the reference's changed-mesh load, semi_auto_parallel_checkpoint_*
+    tests)."""
+    import jax
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import save_state_dict, load_state_dict
+
+    devs = jax.devices()
+    mesh_a = dist.ProcessMesh(
+        np.arange(8).reshape(8), dim_names=["x"])
+    mesh_b = dist.ProcessMesh(
+        np.arange(8).reshape(4, 2), dim_names=["x", "y"])
+
+    w = paddle.to_tensor(
+        np.arange(64, dtype=np.float32).reshape(8, 8))
+    w_a = dist.shard_tensor(w, mesh_a, [dist.Shard(0)])
+    sd = {"w": w_a, "step": 7}
+    save_state_dict(sd, str(tmp_path / "ckpt"))
+
+    # target: same logical tensor, sharded over BOTH axes of mesh_b
+    tgt = paddle.to_tensor(np.zeros((8, 8), np.float32))
+    tgt_b = dist.shard_tensor(tgt, mesh_b,
+                              [dist.Shard(0), dist.Shard(1)])
+    out = {"w": tgt_b, "step": 0}
+    load_state_dict(out, str(tmp_path / "ckpt"))
+    np.testing.assert_allclose(np.asarray(out["w"]._data),
+                               np.arange(64).reshape(8, 8))
+    # placement of the loaded tensor is the TARGET's, not the saved one
+    ns = out["w"]._data.sharding
+    assert not ns.is_fully_replicated
