@@ -110,11 +110,13 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None,
         return files[file][name]
 
     flat = _flat(state_dict)
+    restored_py = {}
     for key, t in flat.items():
         info = meta["tensors"].get(key)
         if info is None:
             continue
         if info["kind"] == "python":
+            restored_py[key] = info["value"]
             continue
         full = np.zeros(tuple(info["shape"]),
                         np.dtype(info["dtype"]))
@@ -133,4 +135,19 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None,
             t._assign_array(new)
     for f in files.values():
         f.close()
+    if restored_py:
+        _write_back_python(state_dict, restored_py)
     return state_dict
+
+
+def _write_back_python(tree, restored, prefix=""):
+    """Restore saved python (non-tensor) values into the nested
+    state_dict in place (the reference restores step counters / LR
+    schedule scalars on resume). Key layout matches _flat()."""
+    for k in list(tree):
+        key = f"{prefix}.{k}" if prefix else str(k)
+        v = tree[k]
+        if isinstance(v, dict):
+            _write_back_python(v, restored, key)
+        elif not isinstance(v, Tensor) and key in restored:
+            tree[k] = restored[key]
