@@ -154,11 +154,101 @@ class QueueDataset:
 
 
 class InMemoryDataset(QueueDataset):
+    """reference InMemoryDataset over the C++ MultiSlot DataFeed
+    (fluid/framework/data_feed.cc): when slots are configured via
+    set_use_var, load_into_memory parses files with the native
+    multi-threaded parser (native/src/datafeed.cc) into per-slot
+    ragged arrays; otherwise falls back to raw lines."""
+
     def load_into_memory(self):
+        slots = getattr(self, "_vars", None)
+        if slots:
+            from paddle_tpu import native
+            import numpy as np
+            is_float = [("float" in str(getattr(v, "dtype", "int64")))
+                        for v in slots]
+            merged = None
+            for f in self._files:
+                parsed = native.parse_multislot_file(f, is_float)
+                if parsed is None:      # no native lib: python parse
+                    parsed = self._py_parse(f, is_float)
+                if merged is None:
+                    merged = [[v, o] for v, o in parsed]
+                else:
+                    for s, (v, o) in enumerate(parsed):
+                        mv, mo = merged[s]
+                        merged[s] = [np.concatenate([mv, v]),
+                                     np.concatenate(
+                                         [mo, o[1:] + mo[-1]])]
+            self._slot_data = [(v, o) for v, o in (merged or [])]
+            self._data = []
+            return
         self._data = []
         for f in self._files:
             with open(f) as fh:
                 self._data += fh.readlines()
+
+    @staticmethod
+    def _py_parse(path, is_float):
+        import numpy as np
+        n = len(is_float)
+        vals = [[] for _ in range(n)]
+        offs = [[0] for _ in range(n)]
+        with open(path) as fh:
+            for line in fh:
+                toks = line.split()
+                i = 0
+                row = [[] for _ in range(n)]
+                ok = True
+                for s in range(n):
+                    if i >= len(toks):
+                        ok = False
+                        break
+                    cnt = int(toks[i]); i += 1
+                    row[s] = toks[i:i + cnt]
+                    i += cnt
+                if not ok:
+                    continue
+                for s in range(n):
+                    conv = float if is_float[s] else int
+                    vals[s] += [conv(t) for t in row[s]]
+                    offs[s].append(offs[s][-1] + len(row[s]))
+        return [(np.asarray(vals[s], np.float32 if is_float[s]
+                            else np.int64),
+                 np.asarray(offs[s], np.int64)) for s in range(n)]
+
+    def get_memory_data_size(self):
+        if getattr(self, "_slot_data", None):
+            return int(self._slot_data[0][1].shape[0] - 1)
+        return len(getattr(self, "_data", []))
+
+    def slot_arrays(self):
+        """Per-slot (values, offsets) ragged arrays (native layout)."""
+        return getattr(self, "_slot_data", [])
+
+    def batch_generator(self, batch_size=None, pad_value=0):
+        """Yield per-slot dense [b, max_len] batches (the feed the PS
+        trainer consumes)."""
+        import numpy as np
+        from paddle_tpu.core.tensor import Tensor
+        bs = batch_size or getattr(self, "_bs", 32)
+        data = getattr(self, "_slot_data", [])
+        if not data:
+            return
+        rows = data[0][1].shape[0] - 1
+        for start in range(0, rows, bs):
+            stop = min(start + bs, rows)
+            batch = []
+            for vals, offs in data:
+                seqs = [vals[offs[i]:offs[i + 1]]
+                        for i in range(start, stop)]
+                ml = max((len(s) for s in seqs), default=1) or 1
+                dense = np.full((len(seqs), ml), pad_value,
+                                vals.dtype)
+                for j, s in enumerate(seqs):
+                    dense[j, :len(s)] = s
+                batch.append(Tensor(dense))
+            yield batch
 
     def local_shuffle(self):
         import random
@@ -166,6 +256,7 @@ class InMemoryDataset(QueueDataset):
 
     def release_memory(self):
         self._data = []
+        self._slot_data = []
 
 
 class ProbabilityEntry:

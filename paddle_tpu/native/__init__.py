@@ -444,3 +444,60 @@ def stat_reset_peak(key: str):
     lib = get_lib()
     if lib is not None:
         lib.pn_stat_reset_peak(key.encode())
+
+
+# --------------------------------------------------------------------------
+# MultiSlot data feed (fluid/framework/data_feed.cc analog): parse the
+# PS-training text format ("<count> v..." per slot per line) in C++
+# threads, returning per-slot (values, offsets) ragged arrays.
+
+def _feed_bind(lib):
+    if getattr(lib, "_feed_bound", False):
+        return
+    lib.pn_feed_parse.restype = ctypes.c_void_p
+    lib.pn_feed_parse.argtypes = [ctypes.c_char_p, ctypes.c_int32,
+                                  ctypes.POINTER(ctypes.c_int32),
+                                  ctypes.c_int32]
+    lib.pn_feed_rows.restype = ctypes.c_int64
+    lib.pn_feed_rows.argtypes = [ctypes.c_void_p]
+    lib.pn_feed_slot_size.restype = ctypes.c_int64
+    lib.pn_feed_slot_size.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.pn_feed_copy_slot.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.pn_feed_free.argtypes = [ctypes.c_void_p]
+    lib._feed_bound = True
+
+
+def parse_multislot_file(path, slot_is_float, num_threads=4):
+    """Parse one MultiSlot text file natively.
+
+    Returns a list (per slot) of (values, offsets) numpy pairs, where
+    offsets is int64[rows+1] and values is int64 or float32 per
+    slot_is_float. None when the native library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    _feed_bind(lib)
+    n = len(slot_is_float)
+    flags = (ctypes.c_int32 * n)(*[1 if f else 0
+                                   for f in slot_is_float])
+    h = lib.pn_feed_parse(str(path).encode(), n, flags, num_threads)
+    if not h:
+        raise FileNotFoundError(path)
+    try:
+        rows = lib.pn_feed_rows(h)
+        out = []
+        for s in range(n):
+            total = lib.pn_feed_slot_size(h, s)
+            vals = np.empty(total, np.float32 if slot_is_float[s]
+                            else np.int64)
+            offs = np.empty(rows + 1, np.int64)
+            lib.pn_feed_copy_slot(
+                h, s, vals.ctypes.data_as(ctypes.c_void_p),
+                offs.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_int64)))
+            out.append((vals, offs))
+        return out
+    finally:
+        lib.pn_feed_free(h)
