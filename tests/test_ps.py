@@ -144,3 +144,52 @@ class TestFleetPsMode:
         after = cluster.pull_sparse(3, ids)
         assert (np.abs(after) <= np.abs(rows) + 1e-7).all()
         assert cluster.sparse_size(3) == 3
+
+
+class TestEndToEndPsPipeline:
+    def test_datafeed_to_ps_to_device_step(self, cluster, tmp_path):
+        """The full PS-mode loop (reference PS CTR flow): MultiSlot
+        file -> InMemoryDataset slot arrays -> sparse rows pulled from
+        the PS -> dense compute on device -> grads pushed back."""
+        import paddle_tpu as paddle
+        import paddle_tpu.distributed as dist
+
+        p = tmp_path / "part-0"
+        p.write_text("2 4 5 1 1\n1 9 1 0\n2 4 9 1 1\n1 5 1 0\n")
+        ds = dist.InMemoryDataset()
+        ds.set_filelist([str(p)])
+
+        class V:
+            def __init__(self, dtype):
+                self.dtype = dtype
+        ds.set_use_var([V("int64"), V("float32")])
+        ds.load_into_memory()
+
+        dim = 4
+        cluster.create_sparse_table(9, dim=dim, optimizer="sgd", lr=0.5,
+                                    initializer="uniform")
+        losses = []
+        for ids_t, label_t in ds.batch_generator(batch_size=2):
+            ids = ids_t.numpy().ravel()
+            rows = cluster.pull_sparse(9, ids)
+            emb = paddle.to_tensor(
+                rows.reshape(ids_t.numpy().shape + (dim,)).mean(1),
+                stop_gradient=False)
+            label = paddle.to_tensor(label_t.numpy().ravel())
+            logit = emb.sum(-1)
+            loss = ((logit - label) ** 2).mean()
+            loss.backward()
+            g = emb.grad.numpy() / ids_t.numpy().shape[1]
+            grows = np.repeat(g, ids_t.numpy().shape[1], axis=0)
+            cluster.push_sparse(9, ids, grows)
+            losses.append(float(loss.numpy()))
+        # re-run the same data: server-side updates reduced the loss
+        relosses = []
+        for ids_t, label_t in ds.batch_generator(batch_size=2):
+            ids = ids_t.numpy().ravel()
+            rows = cluster.pull_sparse(9, ids)
+            emb = rows.reshape(ids_t.numpy().shape + (dim,)).mean(1)
+            logit = emb.sum(-1)
+            relosses.append(float(((logit - label_t.numpy().ravel())
+                                   ** 2).mean()))
+        assert sum(relosses) < sum(losses), (relosses, losses)
