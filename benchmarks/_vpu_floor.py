@@ -1,0 +1,33 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+
+def timeit(name, fn, *args, steps=10, warmup=3):
+    f = jax.jit(fn)
+    out = None
+    for _ in range(warmup):
+        out = f(*args)
+    np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0]))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = f(*args)
+    np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0]))
+    dt = (time.perf_counter() - t0) / steps
+    print(f"{name}: {dt*1e3/24:.3f} ms per 1/24", flush=True)
+
+key = jax.random.PRNGKey(0)
+# one layer's attention scores: [B=8, H=8, S=1024, S=1024] bf16
+s = jax.random.normal(key, (8, 8, 1024, 1024), jnp.bfloat16)
+
+def chain24(fn):
+    def run(x):
+        for _ in range(24):
+            x = fn(x)
+        return x
+    return run
+
+timeit("softmax f32 x24", chain24(
+    lambda x: jax.nn.softmax(x.astype(jnp.float32), -1).astype(x.dtype)), s)
+timeit("exp only x24", chain24(lambda x: jnp.exp(x)), s)
+timeit("copy only x24", chain24(lambda x: x + 1), s)

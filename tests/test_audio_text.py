@@ -1,0 +1,77 @@
+"""Audio feature + text ViterbiDecoder numerics (reference analogs:
+test/legacy_test/test_audio_functions.py, test_viterbi_decode.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestAudioFunctional:
+    def test_mel_hz_roundtrip(self):
+        import paddle_tpu.audio.functional as AF
+        mel = AF.hz_to_mel(440.0)
+        assert abs(AF.mel_to_hz(mel) - 440.0) < 1e-3
+
+    def test_fbank_matrix_shape_and_coverage(self):
+        import paddle_tpu.audio.functional as AF
+        fb = AF.compute_fbank_matrix(sr=16000, n_fft=512, n_mels=40)
+        arr = fb.numpy() if hasattr(fb, "numpy") else np.asarray(fb)
+        assert arr.shape == (40, 257)
+        # every mel filter has some support
+        assert (arr.sum(1) > 0).all()
+
+    def test_spectrogram_matches_scipy_stft_power(self):
+        import scipy.signal as ss
+        import paddle_tpu.audio.features as AFt
+        sr, n_fft, hop = 16000, 512, 160
+        t = np.arange(sr // 4) / sr
+        wav = np.sin(2 * np.pi * 1000 * t).astype(np.float32)
+        spec = AFt.Spectrogram(n_fft=n_fft, hop_length=hop,
+                               window="hann", power=2.0)
+        out = spec(paddle.to_tensor(wav[None])).numpy()[0]
+        # peak frequency bin ~ 1000 Hz
+        peak = out.mean(-1).argmax()
+        expected_bin = round(1000 * n_fft / sr)
+        assert abs(int(peak) - expected_bin) <= 1, (peak, expected_bin)
+
+    def test_mfcc_shape(self):
+        import paddle_tpu.audio.features as AFt
+        wav = np.random.RandomState(0).randn(1, 8000).astype(np.float32)
+        m = AFt.MFCC(sr=16000, n_mfcc=13)
+        out = m(paddle.to_tensor(wav)).numpy()
+        assert out.shape[1] == 13
+
+    def test_power_to_db_clamps(self):
+        import paddle_tpu.audio.functional as AF
+        s = paddle.to_tensor(np.array([1.0, 1e-12], np.float32))
+        db = AF.power_to_db(s)
+        arr = db.numpy() if hasattr(db, "numpy") else np.asarray(db)
+        assert arr[0] - arr[1] <= 80.0 + 1e-5
+
+
+class TestTextViterbi:
+    def test_viterbi_decode_matches_bruteforce(self):
+        from paddle_tpu.text import viterbi_decode
+        rs = np.random.RandomState(0)
+        B, T, N = 2, 4, 3
+        emit = rs.randn(B, T, N).astype(np.float32)
+        trans = rs.randn(N, N).astype(np.float32)
+        lens = np.array([4, 3], np.int64)
+        scores, paths = viterbi_decode(
+            paddle.to_tensor(emit), paddle.to_tensor(trans),
+            paddle.to_tensor(lens), include_bos_eos_tag=False)
+
+        # brute force over all tag sequences
+        import itertools
+        for b in range(B):
+            L = lens[b]
+            best, best_path = -1e30, None
+            for seq in itertools.product(range(N), repeat=int(L)):
+                sc = emit[b, 0, seq[0]]
+                for t in range(1, L):
+                    sc += trans[seq[t - 1], seq[t]] + emit[b, t, seq[t]]
+                if sc > best:
+                    best, best_path = sc, seq
+            np.testing.assert_allclose(float(scores.numpy()[b]), best,
+                                       rtol=1e-4)
+            assert paths.numpy()[b][:L].tolist() == list(best_path)
