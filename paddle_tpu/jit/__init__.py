@@ -271,12 +271,25 @@ def save(layer, path, input_spec=None, **configs):
     if input_spec:
         from jax import export as jexport
         specs = []
+        scope = jexport.SymbolicScope()
+        n_sym = 0
         for s in input_spec:
             if isinstance(s, Tensor):
                 specs.append(jax.ShapeDtypeStruct(s.shape, s._data.dtype))
             else:
-                shape = tuple(1 if d in (-1, None) else d for d in s.shape)
-                specs.append(jax.ShapeDtypeStruct(shape, s.dtype))
+                # -1/None dims export as SYMBOLIC dims (the shape
+                # dialect role, SURVEY §2.4): the saved program serves
+                # any size on those axes
+                shape = []
+                for d in s.shape:
+                    if d in (-1, None):
+                        (dim,) = jexport.symbolic_shape(
+                            f"d{n_sym}", scope=scope)
+                        n_sym += 1
+                        shape.append(dim)
+                    else:
+                        shape.append(int(d))
+                specs.append(jax.ShapeDtypeStruct(tuple(shape), s.dtype))
 
         def run(*xs):
             out = fn(*[Tensor._wrap(x) for x in xs])

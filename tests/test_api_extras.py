@@ -239,3 +239,20 @@ class TestGraphBreakFallback:
         x = paddle.to_tensor(np.ones((2, 4), np.float32))
         np.testing.assert_allclose(g(x).numpy(), lin(x).numpy() + 1,
                                    rtol=1e-5)
+
+
+class TestDynamicShapeExport:
+    def test_saved_program_serves_any_batch(self, tmp_path):
+        """-1 dims in InputSpec export as symbolic dims (the shape
+        dialect role): one saved program serves every batch size."""
+        from paddle_tpu.jit import InputSpec
+        lin = nn.Linear(4, 2)
+        path = str(tmp_path / "dyn")
+        paddle.jit.save(lin, path,
+                        input_spec=[InputSpec([-1, 4], "float32")])
+        tl = paddle.jit.load(path)
+        for b in (1, 3, 17):
+            x = np.random.RandomState(b).randn(b, 4).astype(np.float32)
+            np.testing.assert_allclose(
+                tl(paddle.to_tensor(x)).numpy(),
+                lin(paddle.to_tensor(x)).numpy(), rtol=1e-5)
