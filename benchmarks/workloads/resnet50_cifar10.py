@@ -13,12 +13,18 @@ import time
 import numpy as np
 
 
-def main(smoke=True, steps=20):
+def main(smoke=True, steps=20, use_jit=None):
     import paddle_tpu as paddle
     from paddle_tpu import nn
     from paddle_tpu.io import DataLoader
     from paddle_tpu.vision.datasets import Cifar10, FakeData
     from paddle_tpu.vision.models import resnet18, resnet50
+
+    if use_jit is None:
+        # full mode on TPU compiles the step (per-op eager dispatch
+        # through the tunneled backend is latency-bound); smoke mode
+        # exercises the eager engine
+        use_jit = not smoke
 
     model = resnet18(num_classes=10) if smoke else resnet50(
         num_classes=10)
@@ -39,6 +45,16 @@ def main(smoke=True, steps=20):
         # the loss decrease is a meaningful assertion
         opt.set_lr(0.01)
     model.train()
+
+    def train_step(xb, yb):
+        loss = lossf(model(xb), yb)
+        opt.clear_grad()
+        loss.backward()
+        opt.step()
+        return loss
+
+    step_fn = paddle.jit.to_static(train_step, objs=[model, opt]) \
+        if use_jit else train_step
     losses = []
     t0 = time.time()
     it = iter(dl)
@@ -54,10 +70,7 @@ def main(smoke=True, steps=20):
                 xb, yb = next(it)
         if xb.ndim == 2:                      # flat CIFAR rows
             xb = xb.reshape([xb.shape[0], 3, 32, 32])
-        loss = lossf(model(xb), yb)
-        opt.clear_grad()
-        loss.backward()
-        opt.step()
+        loss = step_fn(xb, yb)
         losses.append(float(loss.numpy()))
     dt = time.time() - t0
     print(f"resnet_cifar10: loss {losses[0]:.3f}->{losses[-1]:.3f} "
