@@ -1,0 +1,77 @@
+"""Elastic membership + fault-handling tests (reference mechanism:
+ElasticManager heartbeats in etcd, node-leave detection, relaunch;
+tests kill members and assert the survivors notice — the reference
+does this ad hoc by killing subprocesses)."""
+import time
+
+import pytest
+
+from paddle_tpu.distributed.elastic import ElasticManager, FileKVStore
+
+
+def test_membership_join_and_leave(tmp_path):
+    store = FileKVStore(str(tmp_path))
+    changes = []
+    m0 = ElasticManager(store, "job", rank=0, heartbeat_s=0.1,
+                        ttl_s=0.5, on_change=changes.append).start()
+    m1 = ElasticManager(store, "job", rank=1, heartbeat_s=0.1,
+                        ttl_s=0.5).start()
+    # wait until m0's WATCHER has observed the join (not just the
+    # store) — stopping m1 earlier would race the first watch tick
+    deadline = time.time() + 5
+    while time.time() < deadline and [0, 1] not in changes:
+        time.sleep(0.05)
+    assert [0, 1] in changes, changes
+    assert m0.world() == [0, 1]
+
+    # node 1 dies (stop heartbeating); TTL expiry -> leave detected
+    m1.stop()
+    deadline = time.time() + 5
+    while time.time() < deadline and not any(w == [0] for w in changes):
+        time.sleep(0.05)
+    assert any(w == [0] for w in changes), changes
+    m0.stop()
+
+
+def test_scale_out_triggers_on_change(tmp_path):
+    store = FileKVStore(str(tmp_path))
+    changes = []
+    m0 = ElasticManager(store, "job2", rank=0, heartbeat_s=0.1,
+                        ttl_s=1.0, on_change=changes.append).start()
+    time.sleep(0.3)
+    m2 = ElasticManager(store, "job2", rank=2, heartbeat_s=0.1,
+                        ttl_s=1.0).start()
+    deadline = time.time() + 5
+    while time.time() < deadline and not any(
+            w == [0, 2] for w in changes):
+        time.sleep(0.05)
+    assert any(w == [0, 2] for w in changes), changes
+    m0.stop()
+    m2.stop()
+
+
+def test_launcher_kills_job_on_worker_failure(tmp_path):
+    """The launcher's failure policy (reference launch controllers):
+    one worker exiting nonzero terminates the whole job with its
+    code."""
+    import subprocess
+    import sys
+    import os
+
+    script = tmp_path / "failer.py"
+    script.write_text(
+        "import os, sys, time\n"
+        "rank = int(os.environ['PADDLE_TRAINER_ID'])\n"
+        "if rank == 1:\n"
+        "    sys.exit(3)\n"
+        "time.sleep(30)\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo"
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", str(script)],
+        env=env, capture_output=True, timeout=25)
+    # job fails fast with the worker's code, not after the 30s sleep
+    assert proc.returncode == 3, (proc.returncode, proc.stderr[-500:])
+    assert time.time() - t0 < 20
