@@ -1,0 +1,61 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time, math
+import numpy as np
+import jax, jax.numpy as jnp
+from paddle_tpu.ops.pallas.simple_attention import attention_bhsd
+from jax.experimental.pallas.ops.tpu.flash_attention import (
+    BlockSizes, flash_attention as fa)
+
+key = jax.random.PRNGKey(0)
+B, H, S, D = 8, 8, 1024, 128
+q = jax.random.normal(key, (B, H, S, D), jnp.bfloat16)
+
+def timeit(name, fn, *args, steps=10, warmup=3):
+    f = jax.jit(fn)
+    try:
+        out = None
+        for _ in range(warmup):
+            out = f(*args)
+        np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0]))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = f(*args)
+        np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0]))
+        dt = (time.perf_counter() - t0) / steps
+        print(f"{name}: {dt*1e3/24:.3f} ms/layer", flush=True)
+    except Exception as e:
+        print(f"{name}: FAIL {type(e).__name__} {str(e)[:160]}", flush=True)
+
+# numerics on-device first
+blk = BlockSizes(block_q=512, block_k_major=512, block_k=512, block_b=1,
+                 block_q_major_dkv=512, block_k_major_dkv=512,
+                 block_k_dkv=512, block_q_dkv=512,
+                 block_k_major_dq=512, block_k_dq=512, block_q_dq=512)
+ref = fa(q, q, q, causal=True, sm_scale=1/math.sqrt(D), block_sizes=blk)
+mine = attention_bhsd(q, q, q, causal=True)
+err = float(jnp.max(jnp.abs(ref.astype(jnp.float32) - mine.astype(jnp.float32))))
+print("max fwd diff vs flash:", err, flush=True)
+
+def chain(att):
+    def run(q):
+        for _ in range(24):
+            q = att(q)
+        return q
+    return run
+
+def g24(att):
+    def run(q):
+        def f(t):
+            for _ in range(24):
+                t = att(t)
+            return t.astype(jnp.float32).sum()
+        return jax.grad(f)(q)
+    return run
+
+simple = lambda t: attention_bhsd(t, t, t, causal=True)
+flash = lambda t: fa(t, t, t, causal=True, sm_scale=1/math.sqrt(D),
+                     block_sizes=blk)
+timeit("simple fwd x24", chain(simple), q)
+timeit("flash  fwd x24", chain(flash), q)
+timeit("simple fwd+bwd x24", g24(simple), q)
+timeit("flash  fwd+bwd x24", g24(flash), q)

@@ -21,7 +21,9 @@ jax.config.update("jax_platforms", "cpu")
 import jax._src.xla_bridge as _xb  # noqa: E402
 
 _xb._backend_factories.pop("axon", None)
-_xb._backend_factories.pop("tpu", None)
+# NOTE: the "tpu" factory stays registered — JAX_PLATFORMS=cpu already
+# prevents backend creation, and popping it unregisters the "tpu"
+# platform from MLIR, which breaks importing pallas kernels in tests.
 _f = _xb._get_backend_uncached
 if getattr(_f, "__name__", "") == "_axon_get_backend_uncached" \
         and _f.__closure__:
