@@ -1,0 +1,59 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time, math, functools
+import numpy as np
+import jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, bh):
+    for hh in range(bh):
+        q = q_ref[0, hh].astype(jnp.float32)
+        k = k_ref[0, hh].astype(jnp.float32)
+        v = v_ref[0, hh]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            sq = s.shape[0]
+            iq = jax.lax.broadcasted_iota(jnp.int32, (sq, sq), 0)
+            ik = jax.lax.broadcasted_iota(jnp.int32, (sq, sq), 1)
+            s = jnp.where(iq >= ik, s, NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        p = (p / l).astype(v.dtype)
+        o = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        o_ref[0, hh] = o.astype(o_ref.dtype)
+
+def attn(q, bh, steps=10, warmup=3):
+    B, H, S, D = q.shape
+    blk = pl.BlockSpec((1, bh, S, D), lambda i, j: (i, j, 0, 0))
+    f = pl.pallas_call(
+        functools.partial(_fwd_kernel, sm_scale=1/math.sqrt(D),
+                          causal=True, bh=bh),
+        grid=(B, H // bh),
+        in_specs=[blk, blk, blk], out_specs=blk,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype))
+    def run(t):
+        for _ in range(24):
+            t = f(t, t, t)
+        return t
+    g = jax.jit(run)
+    out = None
+    for _ in range(warmup):
+        out = g(q)
+    np.asarray(jax.device_get(out.ravel()[0]))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = g(q)
+    np.asarray(jax.device_get(out.ravel()[0]))
+    print(f"bh={bh}: {(time.perf_counter()-t0)/steps/24*1e3:.3f} ms/layer fwd", flush=True)
+
+key = jax.random.PRNGKey(0)
+q = jax.random.normal(key, (8, 8, 1024, 128), jnp.bfloat16)
+for bh in (1, 2):
+    try:
+        attn(q, bh)
+    except Exception as e:
+        print(f"bh={bh}: FAIL {str(e)[:120]}", flush=True)

@@ -27,26 +27,29 @@ def _pl():
     return pl
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal):
-    q = q_ref[0, 0].astype(jnp.float32)            # [S, D]
-    k = k_ref[0, 0].astype(jnp.float32)
-    v = v_ref[0, 0]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * sm_scale   # [S, S]
-    if causal:
-        sq = s.shape[0]
-        iq = jax.lax.broadcasted_iota(jnp.int32, (sq, sq), 0)
-        ik = jax.lax.broadcasted_iota(jnp.int32, (sq, sq), 1)
-        s = jnp.where(iq >= ik, s, NEG_INF)
-    m = jnp.max(s, axis=-1, keepdims=True)
-    p = jnp.exp(s - m)
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    p = (p / l).astype(v.dtype)
-    o = jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    o_ref[0, 0] = o.astype(o_ref.dtype)
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, bh):
+    # bh heads per program: amortizes grid overhead (0.56 vs 0.76
+    # ms/layer at bh=2 on v5e — benchmarks/_simple_attn_h2.py)
+    for hh in range(bh):
+        q = q_ref[0, hh].astype(jnp.float32)        # [S, D]
+        k = k_ref[0, hh].astype(jnp.float32)
+        v = v_ref[0, hh]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale   # [S, S]
+        if causal:
+            sq = s.shape[0]
+            iq = jax.lax.broadcasted_iota(jnp.int32, (sq, sq), 0)
+            ik = jax.lax.broadcasted_iota(jnp.int32, (sq, sq), 1)
+            s = jnp.where(iq >= ik, s, NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        p = (p / l).astype(v.dtype)
+        o = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        o_ref[0, hh] = o.astype(o_ref.dtype)
 
 
 def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, dq_ref, dk_ref, dv_ref, *,
@@ -94,13 +97,23 @@ def simple_attention(q, k, v, sm_scale, causal=True, interpret=False):
     return _fwd(q, k, v, sm_scale, causal, interpret)[0]
 
 
+def _fwd_block_h(s, d, h, dtype):
+    """Heads per fwd program. bh=2 wins standalone (0.56 vs 0.76
+    ms/layer) but LOSES ~4% end-to-end inside the remat train step
+    (VMEM pressure vs XLA scheduling — benchmarks/_simple_attn_h2.py
+    vs bench.py runs), so stay at 1."""
+    return 1
+
+
 def _fwd(q, k, v, sm_scale, causal, interpret):
     pl = _pl()
     b, h, s, d = q.shape
-    blk = pl.BlockSpec((1, 1, s, d), lambda i, j: (i, j, 0, 0))
+    bh = _fwd_block_h(s, d, h, q.dtype)
+    blk = pl.BlockSpec((1, bh, s, d), lambda i, j: (i, j, 0, 0))
     out = pl.pallas_call(
-        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal),
-        grid=(b, h),
+        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                          bh=bh),
+        grid=(b, h // bh),
         in_specs=[blk, blk, blk],
         out_specs=blk,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
