@@ -1,0 +1,65 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+import paddle_tpu.models.gpt_hybrid as gh
+from paddle_tpu.models.gpt import GPTConfig
+from paddle_tpu.models.gpt_hybrid import ParallelConfig, setup
+from jax.ad_checkpoint import checkpoint_name
+
+rng = np.random.RandomState(0)
+
+orig_block = gh._block
+
+def flat_block(x, lp, cfg, pcfg, mesh):
+    """_block with 2-D flattened GEMMs."""
+    from paddle_tpu.models.gpt_hybrid import _layer_norm, _attend, _constrain
+    from jax.sharding import PartitionSpec as P
+    b, s, h = x.shape
+    act_spec = P("dp", None, None)
+    x = _constrain(x, act_spec, mesh)
+    hres = x
+    hx = _layer_norm(x, lp["ln1_g"], lp["ln1_b"])
+    hx2 = hx.reshape(b * s, h)
+    qkv = checkpoint_name((hx2 @ lp["qkv_w"] + lp["qkv_b"])
+                          .reshape(b, s, -1), "qkv")
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    attn = checkpoint_name(_attend(q, k, v, cfg.num_heads), "attn_out")
+    attn = (attn.reshape(b * s, h) @ lp["proj_w"] + lp["proj_b"]) \
+        .reshape(b, s, h)
+    x = hres + attn
+    x = _constrain(x, act_spec, mesh)
+    hres = x
+    hx = _layer_norm(x, lp["ln2_g"], lp["ln2_b"])
+    hx2 = hx.reshape(b * s, h)
+    ff = (jax.nn.gelu(checkpoint_name(hx2 @ lp["fc1_w"] + lp["fc1_b"],
+                                      "ffn1")) @ lp["fc2_w"]
+          + lp["fc2_b"]).reshape(b, s, h)
+    x = hres + ff
+    return _constrain(x, act_spec, mesh)
+
+def bench(name):
+    cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
+                    num_heads=8, max_seq_len=1024)
+    pcfg = ParallelConfig(dp=1, pp=1, tp=1, remat=True,
+                          remat_policy="names",
+                          param_dtype=jnp.bfloat16,
+                          compute_dtype=jnp.bfloat16)
+    mesh, params, opt_state, step = setup(cfg, pcfg, seed=0,
+                                          devices=jax.devices()[:1])
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 1024)))
+    with mesh:
+        for _ in range(2):
+            params, opt_state, loss = step(params, opt_state, (ids, ids))
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(8):
+            params, opt_state, loss = step(params, opt_state, (ids, ids))
+        float(loss)
+        dt = time.perf_counter() - t0
+    print(f"{name}: {8*1024*8/dt:,.0f} tok/s loss={float(loss):.3f}", flush=True)
+
+bench("baseline 3-D")
+gh._block = flat_block
+bench("flattened 2-D")
+gh._block = orig_block

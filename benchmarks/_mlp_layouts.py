@@ -1,0 +1,53 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+
+def timeit(name, fn, *args, steps=10, warmup=3, flops=None):
+    f = jax.jit(fn)
+    out = None
+    for _ in range(warmup):
+        out = f(*args)
+    np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0]))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = f(*args)
+    np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0]))
+    dt = (time.perf_counter() - t0) / steps
+    msg = f"{name}: {dt*1e3:.2f} ms"
+    if flops:
+        msg += f" {flops/dt/1e12:.0f} TF/s ({flops/dt/197e12*100:.0f}%)"
+    print(msg, flush=True)
+
+key = jax.random.PRNGKey(0)
+M, H = 8192, 1024
+x = jax.random.normal(key, (M, H), jnp.bfloat16)
+w1 = jax.random.normal(key, (H, 4*H), jnp.bfloat16)
+w2 = jax.random.normal(key, (4*H, H), jnp.bfloat16)
+FL = 24*2*2*M*H*4*H
+
+def mlp_gelu(x, w1, w2):
+    for _ in range(24):
+        x = jax.nn.gelu(x @ w1) @ w2
+    return x
+timeit("gelu(tanh)", mlp_gelu, x, w1, w2, flops=FL)
+
+def mlp_relu(x, w1, w2):
+    for _ in range(24):
+        x = jax.nn.relu(x @ w1) @ w2
+    return x
+timeit("relu", mlp_relu, x, w1, w2, flops=FL)
+
+def mlp_nogelu(x, w1, w2):
+    for _ in range(24):
+        x = (x @ w1) @ w2
+    return x
+timeit("no-activation", mlp_nogelu, x, w1, w2, flops=FL)
+
+# 3-D batch layout like the model uses [B,S,H]
+x3 = x.reshape(8, 1024, H)
+def mlp3(x, w1, w2):
+    for _ in range(24):
+        x = jax.nn.gelu(x @ w1) @ w2
+    return x
+timeit("gelu 3-D [8,1024,H]", mlp3, x3, w1, w2, flops=FL)
