@@ -19,12 +19,14 @@ from paddle_tpu.models.gpt_hybrid import ParallelConfig, setup
 CFG = dict(vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
            max_seq_len=16)
 LR, B1, B2, EPS, WD = 3e-4, 0.9, 0.95, 1e-8, 0.1
+MEDIUM = dict(vocab_size=512, hidden_size=256, num_layers=4, num_heads=4,
+              max_seq_len=128)
 
 
-def torch_forward(p, ids):
+def torch_forward(p, ids, nh=None):
     x = p["wte"][ids] + p["wpe"][: ids.shape[1]][None]
     L = p["qkv_w"].shape[0]
-    nh = CFG["num_heads"]
+    nh = nh if nh is not None else CFG["num_heads"]
     for i in range(L):
         h = F.layer_norm(x, (x.shape[-1],), p["ln1_g"][i], p["ln1_b"][i])
         qkv = h @ p["qkv_w"][i] + p["qkv_b"][i]
@@ -48,8 +50,8 @@ def torch_forward(p, ids):
     return x @ p["wte"].T
 
 
-def torch_loss(p, ids):
-    logits = torch_forward(p, ids)[:, :-1]
+def torch_loss(p, ids, nh=None):
+    logits = torch_forward(p, ids, nh)[:, :-1]
     tgt = ids[:, 1:]
     return F.cross_entropy(logits.reshape(-1, logits.shape[-1]),
                            tgt.reshape(-1))
@@ -101,3 +103,40 @@ def test_loss_curve_matches_torch():
                                atol=2e-3)
     # both curves must be strictly decreasing on this overfit toy
     assert jax_losses[-1] < jax_losses[0]
+
+
+def test_loss_curve_matches_torch_medium():
+    """Same alignment at a non-toy width (h=256, L=4, S=128)."""
+    import jax
+    cfg = GPTConfig(**MEDIUM)
+    pcfg = ParallelConfig(dp=1, pp=1, tp=1, remat=False,
+                          param_dtype=jnp.float32,
+                          compute_dtype=jnp.float32)
+    mesh, params, opt_state, step = setup(cfg, pcfg, seed=1,
+                                          devices=jax.devices("cpu")[:1])
+    tp = {}
+    flat = {"wte": params["wte"], "wpe": params["wpe"],
+            "lnf_g": params["lnf_g"], "lnf_b": params["lnf_b"],
+            **params["blocks"]}
+    for k, v in flat.items():
+        tp[k] = torch.tensor(np.asarray(v), dtype=torch.float32,
+                             requires_grad=True)
+    opt = torch.optim.AdamW(tp.values(), lr=LR, betas=(B1, B2),
+                            eps=EPS, weight_decay=WD)
+    ids = np.random.RandomState(1).randint(
+        0, MEDIUM["vocab_size"], (2, MEDIUM["max_seq_len"]))
+    jids = jnp.asarray(ids)
+    tids = torch.tensor(ids, dtype=torch.long)
+    jl, tl_ = [], []
+    with mesh:
+        for _ in range(3):
+            params, opt_state, loss = step(params, opt_state,
+                                           (jids, jids))
+            jl.append(float(loss))
+    for _ in range(3):
+        opt.zero_grad()
+        loss = torch_loss(tp, tids, nh=MEDIUM["num_heads"])
+        loss.backward()
+        opt.step()
+        tl_.append(float(loss.detach()))
+    np.testing.assert_allclose(jl, tl_, rtol=5e-3, atol=5e-3)
