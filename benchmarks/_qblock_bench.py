@@ -1,0 +1,48 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time, math
+import numpy as np
+import jax, jax.numpy as jnp
+from paddle_tpu.ops.pallas.simple_attention2 import attention_bhsd
+from jax.experimental.pallas.ops.tpu.flash_attention import (
+    BlockSizes, flash_attention as fa)
+
+key = jax.random.PRNGKey(0)
+B, H, S, D = 4, 8, 2048, 128
+q = jax.random.normal(key, (B, H, S, D), jnp.bfloat16)
+
+def timeit(name, fn, *args, steps=8, warmup=2):
+    f = jax.jit(fn)
+    try:
+        out = None
+        for _ in range(warmup):
+            out = f(*args)
+        np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0]))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = f(*args)
+        np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0]))
+        print(f"{name}: {(time.perf_counter()-t0)/steps/12*1e3:.3f} ms/layer", flush=True)
+    except Exception as e:
+        print(f"{name}: FAIL {type(e).__name__} {str(e)[:140]}", flush=True)
+
+blk = BlockSizes(block_q=512, block_k_major=512, block_k=512, block_b=1,
+                 block_q_major_dkv=512, block_k_major_dkv=512,
+                 block_k_dkv=512, block_q_dkv=512,
+                 block_k_major_dq=512, block_k_dq=512, block_q_dq=512)
+ref = fa(q, q, q, causal=True, sm_scale=1/math.sqrt(D), block_sizes=blk)
+mine = attention_bhsd(q, q, q, causal=True)
+print("max diff:", float(jnp.max(jnp.abs(ref.astype(jnp.float32)-mine.astype(jnp.float32)))), flush=True)
+
+def g12(att):
+    def run(q):
+        def f(t):
+            for _ in range(12):
+                t = att(t)
+            return t.astype(jnp.float32).sum()
+        return jax.grad(f)(q)
+    return run
+
+simple = lambda t: attention_bhsd(t, t, t, causal=True)
+flash = lambda t: fa(t, t, t, causal=True, sm_scale=1/math.sqrt(D), block_sizes=blk)
+timeit("qblock fwd+bwd S2048", g12(simple), q)
+timeit("flash  fwd+bwd S2048", g12(flash), q)

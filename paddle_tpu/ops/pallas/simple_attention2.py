@@ -1,0 +1,171 @@
+"""Q-blocked extension of the monolithic attention kernel (S up to
+~4096): q streams in blocks, k/v stay whole in VMEM, scores per q-block
+fit VMEM; dk/dv accumulate across the (sequential) q-block grid dim.
+
+Used by flash_attention_maybe for sequences too long for the whole-S
+kernel but whose [block_q, S] score strip still fits VMEM."""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _pl():
+    from jax.experimental import pallas as pl
+    return pl
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, bq):
+    pl = _pl()
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)          # [bq, D]
+    k = k_ref[0, 0].astype(jnp.float32)          # [S, D]
+    v = v_ref[0, 0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale   # [bq, S]
+    if causal:
+        skv = s.shape[1]
+        iq = jax.lax.broadcasted_iota(jnp.int32, (bq, skv), 0) + qi * bq
+        ik = jax.lax.broadcasted_iota(jnp.int32, (bq, skv), 1)
+        s = jnp.where(iq >= ik, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    p = (p / l).astype(v.dtype)
+    o = jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    o_ref[0, 0] = o.astype(o_ref.dtype)
+
+
+def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, dq_ref, dk_ref, dv_ref, *,
+                sm_scale, causal, bq):
+    pl = _pl()
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        skv = s.shape[1]
+        iq = jax.lax.broadcasted_iota(jnp.int32, (bq, skv), 0) + qi * bq
+        ik = jax.lax.broadcasted_iota(jnp.int32, (bq, skv), 1)
+        s = jnp.where(iq >= ik, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    l = jnp.sum(e, axis=-1, keepdims=True)
+    p = e / l                                     # [bq, S] f32
+    dv = jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)       # [S, D]
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)       # [bq, S]
+    delta = jnp.sum(dp * p, axis=-1, keepdims=True)
+    ds = p * (dp - delta) * sm_scale
+    dq = jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dk = jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)       # [S, D]
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+    @pl.when(qi > 0)
+    def _acc():
+        dk_ref[0, 0] += dk.astype(dk_ref.dtype)
+        dv_ref[0, 0] += dv.astype(dv_ref.dtype)
+
+
+def _pick_bq(s, d, itemsize, budget=11 * 2 ** 20):
+    """Largest power-of-two q block whose bwd VMEM footprint fits:
+    strips p(f32)+dp(f32) [bq,S] dominate."""
+    for bq in (1024, 512, 256, 128):
+        if bq > s:
+            continue
+        need = (2 * bq * s * 4            # p, dp f32 strips
+                + 4 * s * d * 4           # k, v, dk, dv f32
+                + 3 * bq * d * 4)         # q, do, dq
+        if need <= budget and s % bq == 0:
+            return bq
+    return None
+
+
+def supported(q_shape, dtype):
+    b, h, s, d = q_shape
+    if d % 128 != 0 and d != 64:
+        return False
+    if s % 128 != 0:
+        return False
+    itemsize = 2 if dtype in (jnp.bfloat16, jnp.float16) else 4
+    return _pick_bq(s, d, itemsize) is not None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def qblock_attention(q, k, v, sm_scale, causal=True, interpret=False):
+    """q/k/v: [B, H, S, D] -> [B, H, S, D]; S streamed in q blocks."""
+    return _fwd(q, k, v, sm_scale, causal, interpret)[0]
+
+
+def _fwd(q, k, v, sm_scale, causal, interpret):
+    pl = _pl()
+    b, h, s, d = q.shape
+    itemsize = 2 if q.dtype in (jnp.bfloat16, jnp.float16) else 4
+    bq = _pick_bq(s, d, itemsize)
+    qblk = pl.BlockSpec((1, 1, bq, d), lambda i, j, qi: (i, j, qi, 0))
+    kvblk = pl.BlockSpec((1, 1, s, d), lambda i, j, qi: (i, j, 0, 0))
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                          bq=bq),
+        grid=(b, h, s // bq),
+        in_specs=[qblk, kvblk, kvblk],
+        out_specs=qblk,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+    return out, (q, k, v)
+
+
+def _bwd(sm_scale, causal, interpret, res, do):
+    pl = _pl()
+    q, k, v = res
+    b, h, s, d = q.shape
+    itemsize = 2 if q.dtype in (jnp.bfloat16, jnp.float16) else 4
+    bq = _pick_bq(s, d, itemsize)
+    qblk = pl.BlockSpec((1, 1, bq, d), lambda i, j, qi: (i, j, qi, 0))
+    kvblk = pl.BlockSpec((1, 1, s, d), lambda i, j, qi: (i, j, 0, 0))
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_kernel, sm_scale=sm_scale, causal=causal,
+                          bq=bq),
+        grid=(b, h, s // bq),
+        in_specs=[qblk, kvblk, kvblk, qblk],
+        out_specs=[qblk, kvblk, kvblk],
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
+                   jax.ShapeDtypeStruct(k.shape, jnp.float32),
+                   jax.ShapeDtypeStruct(v.shape, jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+qblock_attention.defvjp(_fwd, _bwd)
+
+
+def attention_bhsd(q, k, v, causal=True, scale=None, interpret=False):
+    d = q.shape[-1]
+    sm = scale if scale is not None else 1.0 / math.sqrt(d)
+    return qblock_attention(q, k, v, sm, causal, interpret)
