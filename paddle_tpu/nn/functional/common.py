@@ -144,7 +144,21 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
             new_shape = (a.shape[0],) + tuple(out_size) + (a.shape[-1],)
         else:
             new_shape = a.shape[:2] + tuple(out_size)
-        if jmode == "nearest" or not align_corners:
+        if jmode == "nearest":
+            # paddle/torch nearest = src_idx = floor(dst * in/out)
+            # (jax.image.resize rounds at pixel centers — different
+            # convention)
+            offset = 1 if data_format.endswith("C") else 2
+            out = a
+            for d in range(nd):
+                axis = offset + d
+                n_in, n_out = spatial[d], out_size[d]
+                idx = jnp.floor(
+                    jnp.arange(n_out) * (n_in / n_out)).astype(jnp.int32)
+                idx = jnp.minimum(idx, n_in - 1)
+                out = jnp.take(out, idx, axis=axis)
+            return out
+        if not align_corners:
             return jax.image.resize(a, new_shape, method=jmode)
         # align_corners: do coordinate mapping manually per spatial dim
         src_sp = spatial

@@ -1,0 +1,149 @@
+"""nn.functional numerics vs torch oracles (reference mechanism:
+OpTest with framework cross-checks; torch-CPU is the independent
+implementation here). Covers the conv/pool/norm/interp family that the
+numpy-oracle sweep can't express compactly."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+rs = np.random.RandomState(3)
+
+
+def t(x):
+    return paddle.to_tensor(x)
+
+
+def tt(x):
+    return torch.tensor(x)
+
+
+class TestConv:
+    def test_conv2d_stride_pad_dilation(self):
+        x = rs.randn(2, 3, 16, 16).astype(np.float32)
+        w = rs.randn(8, 3, 3, 3).astype(np.float32)
+        b = rs.randn(8).astype(np.float32)
+        for stride, pad, dil in [(1, 1, 1), (2, 0, 1), (1, 2, 2)]:
+            out = F.conv2d(t(x), t(w), t(b), stride=stride,
+                           padding=pad, dilation=dil)
+            ref = tF.conv2d(tt(x), tt(w), tt(b), stride=stride,
+                            padding=pad, dilation=dil)
+            np.testing.assert_allclose(out.numpy(), ref.numpy(),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_conv2d_groups(self):
+        x = rs.randn(2, 4, 8, 8).astype(np.float32)
+        w = rs.randn(8, 2, 3, 3).astype(np.float32)
+        out = F.conv2d(t(x), t(w), groups=2, padding=1)
+        ref = tF.conv2d(tt(x), tt(w), groups=2, padding=1)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_conv2d_transpose(self):
+        x = rs.randn(2, 4, 8, 8).astype(np.float32)
+        w = rs.randn(4, 6, 3, 3).astype(np.float32)
+        out = F.conv2d_transpose(t(x), t(w), stride=2, padding=1)
+        ref = tF.conv_transpose2d(tt(x), tt(w), stride=2, padding=1)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_conv1d_and_3d(self):
+        x1 = rs.randn(2, 3, 20).astype(np.float32)
+        w1 = rs.randn(5, 3, 3).astype(np.float32)
+        np.testing.assert_allclose(
+            F.conv1d(t(x1), t(w1), padding=1).numpy(),
+            tF.conv1d(tt(x1), tt(w1), padding=1).numpy(),
+            rtol=2e-4, atol=2e-4)
+        x3 = rs.randn(1, 2, 6, 6, 6).astype(np.float32)
+        w3 = rs.randn(4, 2, 3, 3, 3).astype(np.float32)
+        np.testing.assert_allclose(
+            F.conv3d(t(x3), t(w3), padding=1).numpy(),
+            tF.conv3d(tt(x3), tt(w3), padding=1).numpy(),
+            rtol=2e-4, atol=2e-4)
+
+
+class TestPool:
+    def test_max_avg_pool2d(self):
+        x = rs.randn(2, 3, 12, 12).astype(np.float32)
+        np.testing.assert_allclose(
+            F.max_pool2d(t(x), kernel_size=3, stride=2).numpy(),
+            tF.max_pool2d(tt(x), 3, 2).numpy(), rtol=1e-5)
+        np.testing.assert_allclose(
+            F.avg_pool2d(t(x), kernel_size=2, stride=2).numpy(),
+            tF.avg_pool2d(tt(x), 2, 2).numpy(), rtol=1e-5)
+
+    def test_adaptive_pools(self):
+        x = rs.randn(2, 3, 13, 9).astype(np.float32)
+        np.testing.assert_allclose(
+            F.adaptive_avg_pool2d(t(x), 4).numpy(),
+            tF.adaptive_avg_pool2d(tt(x), 4).numpy(), rtol=1e-5,
+            atol=1e-6)
+        np.testing.assert_allclose(
+            F.adaptive_max_pool2d(t(x), 4).numpy(),
+            tF.adaptive_max_pool2d(tt(x), 4).numpy(), rtol=1e-5)
+
+
+class TestNorm:
+    def test_batch_norm_train_eval(self):
+        x = rs.randn(4, 3, 8, 8).astype(np.float32)
+        g = rs.rand(3).astype(np.float32) + 0.5
+        b = rs.randn(3).astype(np.float32)
+        rm = np.zeros(3, np.float32)
+        rv = np.ones(3, np.float32)
+        out = F.batch_norm(t(x), t(rm.copy()), t(rv.copy()), t(g), t(b),
+                           training=True)
+        ref = tF.batch_norm(tt(x), tt(rm.copy()), tt(rv.copy()), tt(g),
+                            tt(b), training=True)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=2e-4,
+                                   atol=2e-4)
+
+    def test_group_instance_norm(self):
+        x = rs.randn(2, 4, 6, 6).astype(np.float32)
+        np.testing.assert_allclose(
+            F.group_norm(t(x), num_groups=2).numpy(),
+            tF.group_norm(tt(x), 2).numpy(), rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(
+            F.instance_norm(t(x)).numpy(),
+            tF.instance_norm(tt(x)).numpy(), rtol=2e-4, atol=2e-4)
+
+
+class TestInterpolate:
+    @pytest.mark.parametrize("mode,align",
+                             [("nearest", False), ("bilinear", False),
+                              ("bilinear", True)])
+    def test_interpolate_2d(self, mode, align):
+        x = rs.randn(2, 3, 8, 8).astype(np.float32)
+        kw = {} if mode == "nearest" else {"align_corners": align}
+        out = F.interpolate(t(x), size=[12, 12], mode=mode, **kw)
+        ref = tF.interpolate(tt(x), size=[12, 12], mode=mode,
+                             **({} if mode == "nearest"
+                                else {"align_corners": align}))
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=2e-4,
+                                   atol=2e-4)
+
+
+class TestLosses:
+    def test_nll_kl_bce(self):
+        logits = rs.randn(6, 5).astype(np.float32)
+        labels = rs.randint(0, 5, 6).astype(np.int64)
+        np.testing.assert_allclose(
+            F.cross_entropy(t(logits), t(labels)).numpy(),
+            tF.cross_entropy(tt(logits), tt(labels)).numpy(),
+            rtol=1e-5, atol=1e-6)
+        p = rs.rand(4, 3).astype(np.float32)
+        q = rs.rand(4, 3).astype(np.float32)
+        lp = np.log(p / p.sum(-1, keepdims=True))
+        qn = q / q.sum(-1, keepdims=True)
+        np.testing.assert_allclose(
+            F.kl_div(t(lp), t(qn), reduction="batchmean").numpy(),
+            tF.kl_div(tt(lp), tt(qn), reduction="batchmean").numpy(),
+            rtol=1e-5, atol=1e-6)
+        x = rs.rand(8).astype(np.float32)
+        y = (rs.rand(8) > 0.5).astype(np.float32)
+        np.testing.assert_allclose(
+            F.binary_cross_entropy(t(x), t(y)).numpy(),
+            tF.binary_cross_entropy(tt(x), tt(y)).numpy(),
+            rtol=1e-5, atol=1e-6)
