@@ -1,0 +1,91 @@
+"""RNN family numerics vs torch (reference mechanism: rnn op tests in
+test/legacy_test/test_rnn_op.py against numpy rnn reference; torch-CPU
+is the oracle here). Weights are copied across so outputs must match
+exactly up to float32 tolerance."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+rs = np.random.RandomState(5)
+I, H, T, B = 6, 8, 5, 3
+
+
+def _copy_weights(ours, theirs, layer=0, reverse=False, bidir=False):
+    """Copy one direction's weights from torch rnn to ours."""
+    suffix = "_reverse" if reverse else ""
+    w_ih = getattr(theirs, f"weight_ih_l{layer}{suffix}")
+    w_hh = getattr(theirs, f"weight_hh_l{layer}{suffix}")
+    b_ih = getattr(theirs, f"bias_ih_l{layer}{suffix}")
+    b_hh = getattr(theirs, f"bias_hh_l{layer}{suffix}")
+    ours.weight_ih._assign_array(
+        paddle.to_tensor(w_ih.detach().numpy())._data)
+    ours.weight_hh._assign_array(
+        paddle.to_tensor(w_hh.detach().numpy())._data)
+    ours.bias_ih._assign_array(
+        paddle.to_tensor(b_ih.detach().numpy())._data)
+    ours.bias_hh._assign_array(
+        paddle.to_tensor(b_hh.detach().numpy())._data)
+
+
+class TestCellsMatchTorch:
+    def test_lstm_cell(self):
+        ours = nn.LSTMCell(I, H)
+        theirs = torch.nn.LSTM(I, H, num_layers=1, batch_first=True)
+        _copy_weights(ours, theirs)
+        x = rs.randn(B, T, I).astype(np.float32)
+        h = np.zeros((B, H), np.float32)
+        c = np.zeros((B, H), np.float32)
+        hp, cp = paddle.to_tensor(h), paddle.to_tensor(c)
+        outs = []
+        for step in range(T):
+            _, (hp, cp) = ours(paddle.to_tensor(x[:, step]), (hp, cp))
+            outs.append(hp.numpy())
+        ref, _ = theirs(torch.tensor(x))
+        np.testing.assert_allclose(np.stack(outs, 1),
+                                   ref.detach().numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_gru_cell(self):
+        ours = nn.GRUCell(I, H)
+        theirs = torch.nn.GRU(I, H, num_layers=1, batch_first=True)
+        _copy_weights(ours, theirs)
+        x = rs.randn(B, T, I).astype(np.float32)
+        hp = paddle.to_tensor(np.zeros((B, H), np.float32))
+        outs = []
+        for step in range(T):
+            _, hp = ours(paddle.to_tensor(x[:, step]), hp)
+            outs.append(hp.numpy())
+        ref, _ = theirs(torch.tensor(x))
+        np.testing.assert_allclose(np.stack(outs, 1),
+                                   ref.detach().numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_simple_rnn_cell(self):
+        ours = nn.SimpleRNNCell(I, H)
+        theirs = torch.nn.RNN(I, H, num_layers=1, batch_first=True)
+        _copy_weights(ours, theirs)
+        x = rs.randn(B, T, I).astype(np.float32)
+        hp = paddle.to_tensor(np.zeros((B, H), np.float32))
+        outs = []
+        for step in range(T):
+            _, hp = ours(paddle.to_tensor(x[:, step]), hp)
+            outs.append(hp.numpy())
+        ref, _ = theirs(torch.tensor(x))
+        np.testing.assert_allclose(np.stack(outs, 1),
+                                   ref.detach().numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+
+class TestLSTMLayer:
+    def test_lstm_layer_forward_shapes_and_grad(self):
+        lstm = nn.LSTM(I, H, num_layers=1)
+        x = paddle.to_tensor(rs.randn(B, T, I).astype(np.float32),
+                             stop_gradient=False)
+        out, (h, c) = lstm(x)
+        assert list(out.shape) == [B, T, H]
+        assert list(h.shape)[-1] == H
+        out.sum().backward()
+        assert x.grad is not None
