@@ -27,10 +27,85 @@ from .sequence_parallel_utils import (
     register_sequence_parallel_allreduce_hooks)
 from ..ps import PaddleCloudRoleMaker  # noqa: F401
 
+
+class Role:
+    """reference fleet/base/role_maker.py Role constants."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """reference UserDefinedRoleMaker: explicit role/ranks instead of
+    env discovery."""
+
+    def __init__(self, is_collective=False, init_gloo=False, **kwargs):
+        super().__init__(is_collective=is_collective)
+        self._role = {Role.WORKER: "TRAINER",
+                      Role.SERVER: "PSERVER"}.get(
+            kwargs.get("current_id_role", kwargs.get("role",
+                                                     Role.WORKER)),
+            "TRAINER")
+        if "role" in kwargs:
+            self._role = {Role.WORKER: "TRAINER",
+                          Role.SERVER: "PSERVER"}[kwargs["role"]]
+        self._worker_id = int(kwargs.get("current_id", 0))
+        self._num_workers = int(kwargs.get("worker_num", 1))
+        self._servers = list(kwargs.get("server_endpoints", []))
+
+
+class UtilBase:
+    """reference fleet/utils/fs UtilBase shell: barrier/all-gather
+    helpers for user scripts."""
+
+    def barrier(self, comm_world="worker"):
+        from ..env import barrier as _b
+        _b()
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+        return np.asarray(input)
+
+    def get_file_shard(self, files):
+        from ..env import get_rank, get_world_size
+        return [f for i, f in enumerate(files)
+                if i % get_world_size() == get_rank()]
+
+
+class MultiSlotDataGenerator:
+    """reference distributed/fleet/data_generator: user subclasses
+    generate() yielding (slot_name, values) pairs; run() streams the
+    MultiSlot text format to stdout for the DataFeed."""
+
+    def generate_sample(self, line):
+        raise NotImplementedError
+
+    def _format(self, sample):
+        out = []
+        for _name, values in sample:
+            out.append(str(len(values)))
+            out += [str(v) for v in values]
+        return " ".join(out)
+
+    def run_from_stdin(self):
+        import sys
+        for line in sys.stdin:
+            gen = self.generate_sample(line)
+            for sample in (gen() if callable(gen) else gen):
+                sys.stdout.write(self._format(sample) + chr(10))
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    pass
+
 __all__ = [
     "init", "DistributedStrategy", "distributed_model",
     "distributed_optimizer", "get_hybrid_communicate_group",
-    "PaddleCloudRoleMaker", "is_server", "is_worker", "init_server",
+    "PaddleCloudRoleMaker", "UserDefinedRoleMaker", "Role",
+    "UtilBase", "Fleet", "MultiSlotDataGenerator",
+    "MultiSlotStringDataGenerator", "is_server", "is_worker", "init_server",
     "run_server", "init_worker", "stop_worker",
     "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
     "ParallelCrossEntropy", "get_rng_state_tracker", "recompute",
@@ -210,6 +285,7 @@ class HybridParallelOptimizer:
     clear_gradients = clear_grad
 
 
+Fleet = _Fleet
 _fleet = _Fleet()
 
 

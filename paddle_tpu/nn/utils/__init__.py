@@ -1,0 +1,145 @@
+"""paddle.nn.utils equivalent (reference: python/paddle/nn/utils —
+weight_norm/spectral_norm hooks, grad clipping, param<->vector)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ['weight_norm', 'remove_weight_norm', 'spectral_norm',
+           'clip_grad_norm_', 'clip_grad_value_',
+           'parameters_to_vector', 'vector_to_parameters']
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """In-place global-norm gradient clip (reference
+    nn/utils/clip_grad_norm_.py)."""
+    params = [parameters] if isinstance(parameters, Tensor) \
+        else list(parameters)
+    grads = [p.grad for p in params if p.grad is not None]
+    if not grads:
+        return Tensor(np.zeros((), np.float32))
+    if norm_type == float('inf'):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(g._data)) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g._data.astype(jnp.float32)) ** norm_type)
+             for g in grads])) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError(
+            "The total norm for gradients is non-finite")
+    coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        if p.grad is not None:
+            p.grad._assign_array(
+                (p.grad._data.astype(jnp.float32) * coef)
+                .astype(p.grad._data.dtype))
+    return Tensor._wrap(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    """In-place element clip of gradients (reference
+    clip_grad_value_.py)."""
+    params = [parameters] if isinstance(parameters, Tensor) \
+        else list(parameters)
+    cv = float(clip_value)
+    for p in params:
+        if p.grad is not None:
+            p.grad._assign_array(jnp.clip(p.grad._data, -cv, cv))
+
+
+def parameters_to_vector(parameters, name=None):
+    params = list(parameters)
+    return Tensor._wrap(jnp.concatenate(
+        [p._data.reshape(-1) for p in params]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    params = list(parameters)
+    off = 0
+    data = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    for p in params:
+        n = int(np.prod(p.shape)) if p.shape else 1
+        p._assign_array(data[off:off + n].reshape(p._data.shape)
+                        .astype(p._data.dtype))
+        off += n
+
+
+def weight_norm(layer, name='weight', dim=0):
+    """Reparameterize layer.<name> as g * v/||v|| (reference
+    nn/utils/weight_norm_hook.py). The decomposition recomputes the
+    weight on every forward via a pre-forward hook."""
+    w = getattr(layer, name)
+    arr = w._data
+    axes = tuple(i for i in range(arr.ndim) if i != dim)
+    g = jnp.sqrt(jnp.sum(arr.astype(jnp.float32) ** 2, axis=axes,
+                         keepdims=True))
+    v = arr.astype(jnp.float32) / jnp.maximum(g, 1e-12)
+    from paddle_tpu.core.tensor import Parameter
+    layer.add_parameter(name + "_g", Parameter(np.asarray(g)))
+    layer.add_parameter(name + "_v", Parameter(np.asarray(v)))
+
+    def _recompute(ly, inputs):
+        gg = getattr(ly, name + "_g")._data
+        vv = getattr(ly, name + "_v")._data
+        norm = jnp.sqrt(jnp.sum(vv ** 2, axis=axes, keepdims=True))
+        neww = (gg * vv / jnp.maximum(norm, 1e-12)).astype(arr.dtype)
+        getattr(ly, name)._assign_array(neww)
+        return None
+
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer._weight_norm_hook = (handle, name)
+    _recompute(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name='weight'):
+    hook = getattr(layer, "_weight_norm_hook", None)
+    if hook is not None:
+        handle, nm = hook
+        try:
+            handle.remove()
+        except AttributeError:
+            pass
+        del layer._weight_norm_hook
+    return layer
+
+
+def spectral_norm(layer, name='weight', n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Reparameterize with spectral normalization via power iteration
+    (reference nn/utils/spectral_norm_hook.py)."""
+    w = getattr(layer, name)
+    arr = np.asarray(w._data, np.float32)
+    if dim is None:
+        dim = 0
+    mat = np.moveaxis(arr, dim, 0).reshape(arr.shape[dim], -1)
+    rs = np.random.RandomState(0)
+    u = rs.randn(mat.shape[0]).astype(np.float32)
+    u /= np.linalg.norm(u) + eps
+    state = {"u": u}
+
+    def _recompute(ly, inputs):
+        a = np.asarray(getattr(ly, name + "_orig")._data, np.float32)
+        m = np.moveaxis(a, dim, 0).reshape(a.shape[dim], -1)
+        uu = state["u"]
+        for _ in range(n_power_iterations):
+            vv = m.T @ uu
+            vv /= np.linalg.norm(vv) + eps
+            uu = m @ vv
+            uu /= np.linalg.norm(uu) + eps
+        state["u"] = uu
+        sigma = float(uu @ m @ vv)
+        getattr(ly, name)._assign_array(
+            jnp.asarray(a / max(sigma, eps), w._data.dtype))
+        return None
+
+    from paddle_tpu.core.tensor import Parameter
+    layer.add_parameter(name + "_orig", Parameter(arr))
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer._spectral_norm_hook = (handle, name)
+    _recompute(layer, None)
+    return layer
