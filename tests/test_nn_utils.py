@@ -1,0 +1,70 @@
+"""paddle.nn.utils tests (reference: test/legacy_test/test_weight_norm*,
+test_spectral_norm, test_clip_grad_*)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn.utils import (clip_grad_norm_, clip_grad_value_,
+                                 parameters_to_vector,
+                                 remove_weight_norm, spectral_norm,
+                                 vector_to_parameters, weight_norm)
+
+
+def _grads(lin, x):
+    (lin(x) ** 2).mean().backward()
+
+
+def test_clip_grad_norm_scales_to_max():
+    lin = nn.Linear(4, 4)
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(2, 4).astype(np.float32))
+    _grads(lin, x)
+    clip_grad_norm_(lin.parameters(), 0.1)
+    total = np.sqrt(sum(float((p.grad.numpy() ** 2).sum())
+                        for p in lin.parameters()
+                        if p.grad is not None))
+    assert total <= 0.11
+
+
+def test_clip_grad_value_bounds_elements():
+    lin = nn.Linear(4, 4)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32) * 3)
+    _grads(lin, x)
+    clip_grad_value_(lin.parameters(), 0.01)
+    for p in lin.parameters():
+        if p.grad is not None:
+            assert np.abs(p.grad.numpy()).max() <= 0.01 + 1e-7
+
+
+def test_param_vector_roundtrip():
+    lin = nn.Linear(3, 5)
+    vec = parameters_to_vector(lin.parameters())
+    assert vec.numpy().size == 3 * 5 + 5
+    vector_to_parameters(vec * 2, lin.parameters())
+    vec2 = parameters_to_vector(lin.parameters())
+    np.testing.assert_allclose(vec2.numpy(), vec.numpy() * 2, rtol=1e-6)
+
+
+def test_weight_norm_preserves_forward():
+    lin = nn.Linear(4, 4)
+    x = paddle.to_tensor(np.random.RandomState(1)
+                         .randn(2, 4).astype(np.float32))
+    before = lin(x).numpy()
+    weight_norm(lin)
+    np.testing.assert_allclose(lin(x).numpy(), before, rtol=1e-4,
+                               atol=1e-5)
+    assert hasattr(lin, "weight_g") and hasattr(lin, "weight_v")
+    remove_weight_norm(lin)
+    np.testing.assert_allclose(lin(x).numpy(), before, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_spectral_norm_bounds_sigma():
+    lin = nn.Linear(6, 6)
+    lin.weight._assign_array(lin.weight._data * 10)
+    spectral_norm(lin, n_power_iterations=5)
+    x = paddle.to_tensor(np.random.RandomState(2)
+                         .randn(2, 6).astype(np.float32))
+    _ = lin(x)
+    sigma = np.linalg.norm(lin.weight.numpy(), 2)
+    assert sigma <= 1.2, sigma
