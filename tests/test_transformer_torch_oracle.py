@@ -1,0 +1,90 @@
+"""Transformer layer numerics vs torch with copied weights (reference
+mechanism: test/legacy_test/test_transformer_api.py numeric checks)."""
+import numpy as np
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+rs = np.random.RandomState(11)
+E, NH, B, S = 16, 4, 2, 6
+
+
+def _set(lin, w, b):
+    lin.weight._assign_array(paddle.to_tensor(w)._data)
+    lin.bias._assign_array(paddle.to_tensor(b)._data)
+
+
+def test_multi_head_attention_matches_torch():
+    ours = nn.MultiHeadAttention(E, NH)
+    theirs = torch.nn.MultiheadAttention(E, NH, batch_first=True)
+    # torch packs qkv into in_proj [3E, E] (out = x @ W^T + b)
+    wq = rs.randn(E, E).astype(np.float32)
+    wk = rs.randn(E, E).astype(np.float32)
+    wv = rs.randn(E, E).astype(np.float32)
+    wo = rs.randn(E, E).astype(np.float32)
+    bq, bk, bv, bo = (rs.randn(E).astype(np.float32) for _ in range(4))
+    with torch.no_grad():
+        theirs.in_proj_weight.copy_(torch.tensor(
+            np.concatenate([wq, wk, wv], 0)))
+        theirs.in_proj_bias.copy_(torch.tensor(
+            np.concatenate([bq, bk, bv])))
+        theirs.out_proj.weight.copy_(torch.tensor(wo))
+        theirs.out_proj.bias.copy_(torch.tensor(bo))
+    # ours uses out = x @ W + b -> transpose torch's W
+    _set(ours.q_proj, wq.T, bq)
+    _set(ours.k_proj, wk.T, bk)
+    _set(ours.v_proj, wv.T, bv)
+    _set(ours.out_proj, wo.T, bo)
+
+    x = rs.randn(B, S, E).astype(np.float32)
+    out = ours(paddle.to_tensor(x))
+    ref, _ = theirs(torch.tensor(x), torch.tensor(x), torch.tensor(x))
+    np.testing.assert_allclose(out.numpy(), ref.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_transformer_encoder_layer_matches_torch():
+    ours = nn.TransformerEncoderLayer(E, NH, dim_feedforward=32,
+                                      dropout=0.0, activation="relu",
+                                      normalize_before=False)
+    theirs = torch.nn.TransformerEncoderLayer(
+        E, NH, dim_feedforward=32, dropout=0.0, activation="relu",
+        batch_first=True, norm_first=False)
+
+    wq = rs.randn(E, E).astype(np.float32)
+    wk = rs.randn(E, E).astype(np.float32)
+    wv = rs.randn(E, E).astype(np.float32)
+    wo = rs.randn(E, E).astype(np.float32)
+    bq, bk, bv, bo = (rs.randn(E).astype(np.float32) for _ in range(4))
+    w1 = rs.randn(32, E).astype(np.float32)
+    b1 = rs.randn(32).astype(np.float32)
+    w2 = rs.randn(E, 32).astype(np.float32)
+    b2 = rs.randn(E).astype(np.float32)
+    with torch.no_grad():
+        theirs.self_attn.in_proj_weight.copy_(torch.tensor(
+            np.concatenate([wq, wk, wv], 0)))
+        theirs.self_attn.in_proj_bias.copy_(torch.tensor(
+            np.concatenate([bq, bk, bv])))
+        theirs.self_attn.out_proj.weight.copy_(torch.tensor(wo))
+        theirs.self_attn.out_proj.bias.copy_(torch.tensor(bo))
+        theirs.linear1.weight.copy_(torch.tensor(w1))
+        theirs.linear1.bias.copy_(torch.tensor(b1))
+        theirs.linear2.weight.copy_(torch.tensor(w2))
+        theirs.linear2.bias.copy_(torch.tensor(b2))
+
+    attn = ours.self_attn
+    _set(attn.q_proj, wq.T, bq)
+    _set(attn.k_proj, wk.T, bk)
+    _set(attn.v_proj, wv.T, bv)
+    _set(attn.out_proj, wo.T, bo)
+    _set(ours.linear1, w1.T, b1)
+    _set(ours.linear2, w2.T, b2)
+
+    x = rs.randn(B, S, E).astype(np.float32)
+    ours.eval()
+    theirs.eval()
+    out = ours(paddle.to_tensor(x))
+    ref = theirs(torch.tensor(x))
+    np.testing.assert_allclose(out.numpy(), ref.detach().numpy(),
+                               rtol=1e-4, atol=1e-4)
