@@ -1,0 +1,90 @@
+"""Optimizer update-rule numerics vs torch with identical params/grads
+(reference mechanism: per-op adam/sgd/momentum op tests vs numpy)."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+rs = np.random.RandomState(13)
+
+
+def _pair(lr_kwargs_ours, torch_cls, torch_kwargs, ours_cls, steps=4):
+    w0 = rs.randn(4, 3).astype(np.float32)
+    grads = [rs.randn(4, 3).astype(np.float32) for _ in range(steps)]
+
+    lin = nn.Linear(4, 3, bias_attr=False)
+    lin.weight._assign_array(paddle.to_tensor(w0)._data)
+    opt = ours_cls(parameters=lin.parameters(), **lr_kwargs_ours)
+
+    tw = torch.tensor(w0.T.copy(), requires_grad=True)  # torch [out,in]
+    topt = torch_cls([tw], **torch_kwargs)
+
+    for g in grads:
+        lin.weight.clear_grad()
+        lin.weight.grad = paddle.to_tensor(g)
+        opt.step()
+        topt.zero_grad()
+        tw.grad = torch.tensor(g.T.copy())
+        topt.step()
+    np.testing.assert_allclose(lin.weight.numpy(),
+                               tw.detach().numpy().T, rtol=2e-5,
+                               atol=2e-6)
+
+
+def test_sgd_matches_torch():
+    _pair(dict(learning_rate=0.1), torch.optim.SGD, dict(lr=0.1),
+          paddle.optimizer.SGD)
+
+
+def test_momentum_matches_torch():
+    _pair(dict(learning_rate=0.05, momentum=0.9),
+          torch.optim.SGD, dict(lr=0.05, momentum=0.9),
+          paddle.optimizer.Momentum)
+
+
+def test_adam_matches_torch():
+    _pair(dict(learning_rate=1e-2, beta1=0.9, beta2=0.999,
+               epsilon=1e-8),
+          torch.optim.Adam, dict(lr=1e-2, betas=(0.9, 0.999),
+                                 eps=1e-8),
+          paddle.optimizer.Adam)
+
+
+def test_adamw_matches_torch():
+    _pair(dict(learning_rate=1e-2, beta1=0.9, beta2=0.999,
+               epsilon=1e-8, weight_decay=0.05),
+          torch.optim.AdamW, dict(lr=1e-2, betas=(0.9, 0.999),
+                                  eps=1e-8, weight_decay=0.05),
+          paddle.optimizer.AdamW)
+
+
+def test_adagrad_matches_torch():
+    _pair(dict(learning_rate=0.05, initial_accumulator_value=0.0,
+               epsilon=1e-10),
+          torch.optim.Adagrad, dict(lr=0.05, eps=1e-10),
+          paddle.optimizer.Adagrad)
+
+
+def test_rmsprop_matches_paddle_formula():
+    """paddle's RMSProp puts epsilon INSIDE the sqrt (rmsprop.py:62:
+    w -= lr*g/sqrt(ms + eps)) — torch puts it outside, so the oracle
+    here is the paddle formula in numpy."""
+    w = rs.randn(4, 3).astype(np.float32)
+    grads = [rs.randn(4, 3).astype(np.float32) for _ in range(4)]
+    lin = nn.Linear(4, 3, bias_attr=False)
+    lin.weight._assign_array(paddle.to_tensor(w)._data)
+    opt = paddle.optimizer.RMSProp(learning_rate=0.01, rho=0.99,
+                                   epsilon=1e-8,
+                                   parameters=lin.parameters())
+    ms = np.zeros_like(w)
+    ref = w.copy()
+    for g in grads:
+        lin.weight.clear_grad()
+        lin.weight.grad = paddle.to_tensor(g)
+        opt.step()
+        ms = 0.99 * ms + 0.01 * g * g
+        ref -= 0.01 * g / np.sqrt(ms + 1e-8)
+    np.testing.assert_allclose(lin.weight.numpy(), ref, rtol=2e-5,
+                               atol=2e-6)
