@@ -1,0 +1,45 @@
+"""paddle.metric numerics vs sklearn oracles (reference mechanism:
+test/legacy_test/test_metrics.py numpy checks)."""
+import numpy as np
+from sklearn import metrics as skm
+
+import paddle_tpu as paddle
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+
+rs = np.random.RandomState(17)
+
+
+def test_accuracy_top1():
+    logits = rs.randn(32, 5).astype(np.float32)
+    labels = rs.randint(0, 5, (32, 1)).astype(np.int64)
+    m = Accuracy()
+    corr = m.compute(paddle.to_tensor(logits), paddle.to_tensor(labels))
+    m.update(corr)
+    ref = skm.accuracy_score(labels.ravel(), logits.argmax(-1))
+    np.testing.assert_allclose(m.accumulate(), ref, rtol=1e-6)
+
+
+def test_precision_recall_binary():
+    preds = rs.rand(64).astype(np.float32)
+    labels = (rs.rand(64) > 0.5).astype(np.int64)
+    p = Precision()
+    p.update(preds, labels)
+    r = Recall()
+    r.update(preds, labels)
+    hard = (preds > 0.5).astype(np.int64)
+    np.testing.assert_allclose(
+        p.accumulate(), skm.precision_score(labels, hard), rtol=1e-6)
+    np.testing.assert_allclose(
+        r.accumulate(), skm.recall_score(labels, hard), rtol=1e-6)
+
+
+def test_auc_close_to_sklearn():
+    # thresholded-bucket AUC (the reference's implementation) converges
+    # to exact AUC with enough buckets
+    scores = rs.rand(512).astype(np.float32)
+    labels = (rs.rand(512) < scores).astype(np.int64)  # informative
+    a = Auc(num_thresholds=4095)
+    preds2 = np.stack([1 - scores, scores], 1)
+    a.update(preds2, labels.reshape(-1, 1))
+    ref = skm.roc_auc_score(labels, scores)
+    np.testing.assert_allclose(a.accumulate(), ref, atol=2e-3)
