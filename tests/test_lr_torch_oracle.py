@@ -1,0 +1,75 @@
+"""LR scheduler curves vs torch equivalents (reference mechanism:
+test/legacy_test/test_lr_scheduler.py numpy formulas)."""
+import numpy as np
+import torch
+
+import paddle_tpu as paddle
+
+
+def _torch_curve(sched_cls, steps, **kw):
+    p = torch.nn.Parameter(torch.zeros(1))
+    opt = torch.optim.SGD([p], lr=kw.pop("base_lr"))
+    s = sched_cls(opt, **kw)
+    out = []
+    for _ in range(steps):
+        out.append(opt.param_groups[0]["lr"])
+        opt.step()
+        s.step()
+    return out
+
+
+def _ours_curve(sched, steps):
+    out = []
+    for _ in range(steps):
+        out.append(sched.get_lr())
+        sched.step()
+    return out
+
+
+def test_step_decay_matches_torch():
+    ours = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=3,
+                                         gamma=0.5)
+    ref = _torch_curve(torch.optim.lr_scheduler.StepLR, 10,
+                       base_lr=0.1, step_size=3, gamma=0.5)
+    np.testing.assert_allclose(_ours_curve(ours, 10), ref, rtol=1e-6)
+
+
+def test_multistep_matches_torch():
+    ours = paddle.optimizer.lr.MultiStepDecay(
+        learning_rate=0.1, milestones=[2, 5], gamma=0.1)
+    ref = _torch_curve(torch.optim.lr_scheduler.MultiStepLR, 8,
+                       base_lr=0.1, milestones=[2, 5], gamma=0.1)
+    np.testing.assert_allclose(_ours_curve(ours, 8), ref, rtol=1e-6)
+
+
+def test_exponential_matches_torch():
+    ours = paddle.optimizer.lr.ExponentialDecay(learning_rate=0.2,
+                                                gamma=0.9)
+    ref = _torch_curve(torch.optim.lr_scheduler.ExponentialLR, 8,
+                       base_lr=0.2, gamma=0.9)
+    np.testing.assert_allclose(_ours_curve(ours, 8), ref, rtol=1e-6)
+
+
+def test_cosine_annealing_matches_torch():
+    ours = paddle.optimizer.lr.CosineAnnealingDecay(
+        learning_rate=0.1, T_max=10)
+    ref = _torch_curve(torch.optim.lr_scheduler.CosineAnnealingLR, 10,
+                       base_lr=0.1, T_max=10)
+    np.testing.assert_allclose(_ours_curve(ours, 10), ref, rtol=1e-5)
+
+
+def test_lambda_matches_torch():
+    ours = paddle.optimizer.lr.LambdaDecay(
+        learning_rate=0.5, lr_lambda=lambda e: 0.95 ** e)
+    ref = _torch_curve(torch.optim.lr_scheduler.LambdaLR, 6,
+                       base_lr=0.5, lr_lambda=lambda e: 0.95 ** e)
+    np.testing.assert_allclose(_ours_curve(ours, 6), ref, rtol=1e-6)
+
+
+def test_linear_warmup_shape():
+    ours = paddle.optimizer.lr.LinearWarmup(
+        learning_rate=0.1, warmup_steps=4, start_lr=0.0, end_lr=0.1)
+    curve = _ours_curve(ours, 6)
+    np.testing.assert_allclose(curve[:4],
+                               [0.0, 0.025, 0.05, 0.075], rtol=1e-6)
+    assert curve[4] == 0.1
