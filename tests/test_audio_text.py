@@ -75,3 +75,31 @@ class TestTextViterbi:
             np.testing.assert_allclose(float(scores.numpy()[b]), best,
                                        rtol=1e-4)
             assert paths.numpy()[b][:L].tolist() == list(best_path)
+
+
+class TestSignalStft:
+    def test_stft_matches_scipy(self):
+        import scipy.signal as ss
+        x = np.sin(2 * np.pi * 440 * np.arange(4000) / 16000) \
+            .astype(np.float32)
+        n_fft, hop = 512, 128
+        out = paddle.signal.stft(paddle.to_tensor(x[None]), n_fft=n_fft,
+                                 hop_length=hop, center=True,
+                                 pad_mode="reflect").numpy()[0]
+        _, _, ref = ss.stft(x, nperseg=n_fft, noverlap=n_fft - hop,
+                            window="hann", boundary="even",
+                            padded=False, return_onesided=True)
+        # scipy normalizes by window sum; compare shapes + peak bin
+        assert out.shape[0] == n_fft // 2 + 1
+        peak_ours = np.abs(out).mean(-1).argmax()
+        peak_ref = np.abs(ref).mean(-1).argmax()
+        assert abs(int(peak_ours) - int(peak_ref)) <= 1
+
+    def test_stft_istft_roundtrip(self):
+        rs2 = np.random.RandomState(0)
+        x = rs2.randn(1, 2048).astype(np.float32)
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=256,
+                                  hop_length=64)
+        back = paddle.signal.istft(spec, n_fft=256, hop_length=64,
+                                   length=2048).numpy()
+        np.testing.assert_allclose(back, x, rtol=1e-3, atol=1e-4)
