@@ -52,6 +52,8 @@ class ParallelConfig:
     # (cuts the ~1/3 recompute FLOPs of full remat at modest memory cost)
     remat_policy: str = "full"
     zero1: bool = True        # shard adam moments over dp
+    fused_ce: bool = True     # chunked LM-head+CE (ops/fused_ce.py);
+                              # never materializes [T, V] logits
     scan_unroll: int = 1      # lax.scan unroll over layers (full unroll
                               # buys ~4% on v5e at higher compile time)
     param_dtype: Any = jnp.float32
@@ -269,8 +271,8 @@ def _stack_apply(blocks, x, cfg, pcfg, mesh):
     return out
 
 
-def forward(params, input_ids, cfg: GPTConfig, pcfg: ParallelConfig,
-            mesh: Mesh):
+def forward_hidden(params, input_ids, cfg: GPTConfig,
+                   pcfg: ParallelConfig, mesh: Mesh):
     cdt = pcfg.compute_dtype
     b, s = input_ids.shape
     x = params["wte"][input_ids].astype(cdt) + \
@@ -336,14 +338,32 @@ def forward(params, input_ids, cfg: GPTConfig, pcfg: ParallelConfig,
     else:
         x = _stack_apply(blocks, x, cfg, pcfg, mesh)
 
-    x = _layer_norm(x, params["lnf_g"].astype(cdt),
-                    params["lnf_b"].astype(cdt))
-    logits = jnp.einsum("bsh,vh->bsv", x, params["wte"].astype(cdt))
-    return logits
+    return _layer_norm(x, params["lnf_g"].astype(cdt),
+                       params["lnf_b"].astype(cdt))
+
+
+def forward(params, input_ids, cfg: GPTConfig, pcfg: ParallelConfig,
+            mesh: Mesh):
+    x = forward_hidden(params, input_ids, cfg, pcfg, mesh)
+    return jnp.einsum("bsh,vh->bsv", x,
+                      params["wte"].astype(pcfg.compute_dtype))
 
 
 def loss_fn(params, batch, cfg, pcfg, mesh):
     input_ids, labels = batch
+    if pcfg.fused_ce:
+        from paddle_tpu.ops.fused_ce import fused_lm_ce
+        x = forward_hidden(params, input_ids, cfg, pcfg, mesh)
+        b, s, h = x.shape
+        # next-token targets with the final position masked out
+        tgt = jnp.concatenate([labels[:, 1:],
+                               jnp.zeros((b, 1), labels.dtype)], axis=1)
+        mask = jnp.concatenate(
+            [jnp.ones((b, s - 1), jnp.float32),
+             jnp.zeros((b, 1), jnp.float32)], axis=1)
+        w = params["wte"].astype(x.dtype)
+        return fused_lm_ce(x.reshape(b * s, h), w,
+                           tgt.reshape(b * s), mask.reshape(b * s))
     logits = forward(params, input_ids, cfg, pcfg, mesh)
     logits = logits[:, :-1].astype(jnp.float32)
     tgt = labels[:, 1:]

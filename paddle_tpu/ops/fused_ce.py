@@ -1,0 +1,115 @@
+"""Fused LM-head + softmax cross-entropy (chunked, logits never stored).
+
+Reference analog: ParallelCrossEntropy / softmax_with_cross_entropy
+(fleet/layers/mpu/mp_layers.py ParallelCrossEntropy; phi softmax-CE
+kernels) — the device-side fusion that avoids materializing the
+[tokens, vocab] softmax. TPU design: chunk the token dim with lax.scan;
+each logits tile lives only inside one fused XLA region, and the
+backward recomputes the tile instead of saving it. Residuals are
+O(tokens) (logz/picked) + the inputs — the [T, V] fp32 logits (≈1.6 GB
+at B8/S1024/V50k) are never written to HBM as a residual.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Measured on v5e at B8/S1024/V50k: ONE big chunk wins (15.6 ms
+# fwd+bwd vs 19.2 at C=2048 vs 18.2 for the non-custom-vjp path) —
+# the scan carry costs more than the transient [C, V] tile; the
+# durable win is the custom-vjp recompute (no logits residual).
+# Cap the chunk at 8192 tokens to bound the transient fp32 tile
+# (~1.6 GB at V=50k) for bigger batches.
+_CHUNK_CAP = 8192
+
+
+def _chunked(t: int):
+    """(chunk, padded_t): chunk = min(t, cap); pad t to a multiple."""
+    c = min(t, _CHUNK_CAP)
+    pt = -(-t // c) * c
+    return c, pt
+
+
+@jax.custom_vjp
+def fused_lm_ce(x, w, targets, weights):
+    """Weighted-mean token cross-entropy of softmax(x @ w.T) vs targets.
+
+    x: [T, H] activations (bf16/fp32), w: [V, H] tied LM head weight,
+    targets: [T] int labels, weights: [T] f32 per-token weights (use
+    0/1 to mask padding). Returns sum(w_i * ce_i) / sum(w_i) as f32
+    (0 when all weights are 0).
+    """
+    loss, _ = _fwd(x, w, targets, weights)
+    return loss
+
+
+def _pad(a, pt):
+    t = a.shape[0]
+    if pt == t:
+        return a
+    pad = [(0, pt - t)] + [(0, 0)] * (a.ndim - 1)
+    return jnp.pad(a, pad)
+
+
+def _fwd(x, w, targets, weights):
+    t = x.shape[0]
+    c, pt = _chunked(t)
+    xc = _pad(x, pt).reshape(pt // c, c, x.shape[1])
+    tc = _pad(targets, pt).reshape(pt // c, c)
+    wc = _pad(weights.astype(jnp.float32), pt).reshape(pt // c, c)
+
+    def body(carry, inp):
+        xi, ti, wi = inp
+        logits = jnp.einsum("ch,vh->cv", xi, w,
+                            preferred_element_type=jnp.float32)
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, ti[:, None], axis=-1)[:, 0]
+        return carry + jnp.sum(wi * (logz - picked)), (logz, picked)
+
+    total, (logz, picked) = lax.scan(
+        body, jnp.zeros((), jnp.float32), (xc, tc, wc))
+    denom = jnp.sum(weights.astype(jnp.float32))
+    safe = jnp.where(denom > 0, denom, 1.0)
+    loss = jnp.where(denom > 0, total / safe, 0.0)
+    return loss, (x, w, targets, weights,
+                  logz.reshape(pt)[:t], picked.reshape(pt)[:t], denom)
+
+
+def _bwd(res, g):
+    x, w, targets, weights, logz, picked, denom = res
+    t, h = x.shape
+    c, pt = _chunked(t)
+    safe = jnp.where(denom > 0, denom, 1.0)
+    live = denom > 0
+    xc = _pad(x, pt).reshape(pt // c, c, h)
+    tc = _pad(targets, pt).reshape(pt // c, c)
+    zc = _pad(logz, pt).reshape(pt // c, c)
+    wf = weights.astype(jnp.float32)
+    sc = _pad(jnp.where(live, wf * (g / safe), 0.0),
+              pt).reshape(pt // c, c)
+
+    def body(dw, inp):
+        xi, ti, zi, si = inp
+        logits = jnp.einsum("ch,vh->cv", xi, w,
+                            preferred_element_type=jnp.float32)
+        p = jnp.exp(logits - zi[:, None])
+        onehot = jax.nn.one_hot(ti, w.shape[0], dtype=jnp.float32)
+        dlog = ((p - onehot) * si[:, None]).astype(w.dtype)   # [C, V]
+        dxi = jnp.einsum("cv,vh->ch", dlog, w,
+                         preferred_element_type=jnp.float32)
+        dw = dw + jnp.einsum("cv,ch->vh", dlog, xi,
+                             preferred_element_type=jnp.float32)
+        return dw, dxi.astype(x.dtype)
+
+    dw, dxc = lax.scan(body, jnp.zeros(w.shape, jnp.float32),
+                       (xc, tc, zc, sc))
+    # d loss / d w_i = (ce_i - loss) / denom  (quotient rule)
+    ce = logz - picked
+    loss = jnp.sum(wf * ce) / safe
+    dweights = jnp.where(live, g * (ce - loss) / safe, 0.0) \
+        .astype(weights.dtype)
+    return (dxc.reshape(pt, h)[:t], dw.astype(w.dtype), None, dweights)
+
+
+fused_lm_ce.defvjp(_fwd, _bwd)
