@@ -25,10 +25,15 @@ _CHUNK_CAP = 8192
 
 
 def _chunked(t: int):
-    """(chunk, padded_t): chunk = min(t, cap); pad t to a multiple."""
-    c = min(t, _CHUNK_CAP)
-    pt = -(-t // c) * c
-    return c, pt
+    """(chunk, padded_t). n = ceil(t / cap) near-equal chunks, each
+    rounded up to a 128-row tile, so padding waste stays at a few
+    percent (naive pad-to-cap wastes up to ~2x at t slightly over the
+    cap, e.g. t=8200 -> pt=16384)."""
+    if t <= _CHUNK_CAP:
+        return t, t
+    n = -(-t // _CHUNK_CAP)
+    c = -(-(-(-t // n)) // 128) * 128
+    return c, n * c
 
 
 @jax.custom_vjp

@@ -69,6 +69,12 @@ def flash_attention_maybe(q, k, v, causal=False, scale=None):
             return None
         from paddle_tpu.ops.pallas import simple_attention as sa
         from paddle_tpu.ops.pallas import simple_attention2 as sa2
+        # NOTE: ops/pallas/causal_attention.py (blockwise causal-skip)
+        # was measured SLOWER end-to-end than the full-S^2 simple
+        # kernel at S=1024 on v5e (48.7-49.1k vs 50.6k tok/s) — the
+        # kernel is VPU/VMEM-bound, not MAC-bound, so skipping the
+        # upper triangle does not pay. It stays available as an op
+        # but is deliberately not in this dispatch chain.
         bhsd = (q.shape[0], q.shape[2], q.shape[1], q.shape[3])
         if q.shape[1] == k.shape[1] and sa.supported(bhsd, q.dtype):
             qt = jnp.swapaxes(q, 1, 2)
