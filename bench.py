@@ -74,6 +74,18 @@ def main():
 
     tokens_per_sec = batch * seq * steps / dt
 
+    if os.environ.get("BENCH_LOSS_CURVE") == "1":
+        # per-step scalar readback breaks async pipelining, so the
+        # curve is sampled AFTER the timed window (stderr only; the
+        # stdout contract stays one JSON line)
+        curve = []
+        with mesh:
+            for _ in range(5):
+                params, opt_state, loss = step(params, opt_state,
+                                               (ids, ids))
+                curve.append(round(float(loss), 6))
+        print(json.dumps({"loss_curve_tail": curve}), file=sys.stderr)
+
     # A100@40%MFU proxy for this exact model (6*N + 12*L*H*S attention)
     h, L, s = cfg.hidden_size, cfg.num_layers, seq
     n_params = (cfg.vocab_size * h + cfg.max_seq_len * h

@@ -6,6 +6,10 @@ TPU-native: JAX threefry keys. The reference's (seed, offset) pair maps to
 which is the same splittable-counter discipline phi uses for philox offsets and
 is safe under jit (the counter is read at trace time; traced programs get a key
 argument instead — see paddle_tpu.jit).
+
+Key creation is LAZY: `jax.random.PRNGKey` initializes the device backend,
+and `import paddle_tpu` must not touch devices (host-only tools — the
+launcher, dataset workers — import the package with no accelerator).
 """
 from __future__ import annotations
 
@@ -23,7 +27,7 @@ class Generator:
     def manual_seed(self, seed: int):
         with getattr(self, "_lock", threading.Lock()):
             self._seed = int(seed)
-            self._key = jax.random.PRNGKey(self._seed)
+            self._key = None          # materialized on first use
             self._offset = 0
         return self
 
@@ -35,15 +39,20 @@ class Generator:
 
     def set_state(self, state):
         self._seed = int(state["seed"])
-        self._key = jax.random.PRNGKey(self._seed)
+        self._key = None
         self._offset = int(state["offset"])
+
+    def _base_key(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
+        return self._key
 
     def next_key(self):
         """One fresh PRNG key; bumps the offset (philox-offset equivalent)."""
         with self._lock:
             off = self._offset
             self._offset += 1
-        return jax.random.fold_in(self._key, off)
+        return jax.random.fold_in(self._base_key(), off)
 
     def initial_seed(self):
         return self._seed

@@ -42,15 +42,21 @@ def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement],
                 "arises only as an op-output state and is reduced by "
                 "reshard()")
     ns = _named_sharding(mesh, placements, data.ndim)
-    arr = jax.device_put(data._data, ns)
     if isinstance(data, (Parameter,)):
-        data._assign_array(arr)
+        data._assign_array(jax.device_put(data._data, ns))
         out = data
+    elif not data.stop_gradient:
+        # keep the autograd link: sharding is identity w.r.t. values,
+        # so the tape records it like any other op
+        from paddle_tpu.core.dispatch import run_op
+        out = run_op("shard_tensor", lambda a: jax.device_put(a, ns),
+                     data, amp=False)
+        if stop_gradient is not None:
+            out.stop_gradient = stop_gradient
     else:
-        out = Tensor._wrap(arr, data.stop_gradient
+        out = Tensor._wrap(jax.device_put(data._data, ns),
+                           data.stop_gradient
                            if stop_gradient is None else stop_gradient)
-        out._grad_node = data._grad_node
-        out._out_idx = data._out_idx
     out._sharding_hint = ns
     return out
 
@@ -58,9 +64,13 @@ def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement],
 def reshard(x: Tensor, mesh: ProcessMesh,
             placements: Sequence[Placement]) -> Tensor:
     """Change placements (reference api.py:703; the R/S/P reshard-function
-    lattice collapses into one device_put — XLA picks the collective)."""
+    lattice collapses into one device_put — XLA picks the collective).
+    Routed through run_op so it sits on the autograd tape (the reference
+    reshard has a backward; grad moves back as the transpose resharding)."""
     ns = _named_sharding(mesh, placements, x.ndim)
-    out = Tensor._wrap(jax.device_put(x._data, ns), x.stop_gradient)
+    from paddle_tpu.core.dispatch import run_op
+    out = run_op("reshard", lambda a: jax.device_put(a, ns), x,
+                 amp=False)
     out._sharding_hint = ns
     return out
 

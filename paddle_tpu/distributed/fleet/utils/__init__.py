@@ -1,0 +1,26 @@
+"""fleet.utils namespace (reference
+python/paddle/distributed/fleet/utils/__init__.py: exports LocalFS,
+HDFSClient, recompute, DistributedInfer plus the helper submodules)."""
+from __future__ import annotations
+
+from paddle_tpu.distributed.fleet.recompute import recompute  # noqa: F401
+
+from . import (  # noqa: F401
+    fs,
+    hybrid_parallel_util,
+    log_util,
+    mix_precision_utils,
+    ps_util,
+    timer_helper,
+)
+from .fs import HDFSClient, LocalFS  # noqa: F401
+from .ps_util import DistributedInfer  # noqa: F401
+
+# reference modules that live one level up in this tree, re-exported
+# under their reference paths
+from paddle_tpu.distributed.fleet import (  # noqa: F401
+    pp_parallel_adaptor,
+    sequence_parallel_utils,
+)
+
+__all__ = ["LocalFS", "recompute", "DistributedInfer", "HDFSClient"]

@@ -1,0 +1,95 @@
+"""Hybrid-parallel gradient/parameter sync helpers (reference
+fleet/utils/hybrid_parallel_util.py).
+
+TPU design: collectives go through paddle_tpu.distributed.collective
+(XLA collectives / replicated device_put); "fused" bucketing is kept as
+an API but the XLA runtime already coalesces — each call is one
+collective per parameter group."""
+from __future__ import annotations
+
+from paddle_tpu.distributed import collective as C
+
+
+def obtain_optimizer_parameters_list(optimizer):
+    inner = getattr(optimizer, "_inner_opt", None) or optimizer
+    params = getattr(inner, "_parameter_list", None) or []
+    if params and isinstance(params[0], dict):
+        flat = []
+        for group in params:
+            flat.extend(group.get("params", []))
+        return flat
+    return list(params)
+
+
+def unwrap_optimizer(optimizer, optimizer_instances=()):
+    opt = optimizer
+    while optimizer_instances and isinstance(opt, optimizer_instances):
+        opt = opt._inner_opt
+    return opt
+
+
+def _group_nranks(group):
+    return getattr(group, "nranks", None) or getattr(group, "world_size",
+                                                     1) or 1
+
+
+def fused_allreduce_gradients_with_group(parameter_list, group,
+                                         bucket_size=128 * 1024 * 1024,
+                                         scale=None):
+    """Allreduce every present grad over `group`, scaling by 1/nranks
+    (the reference scales by the group size after sum)."""
+    n = _group_nranks(group)
+    for p in parameter_list:
+        g = getattr(p, "grad", None)
+        if g is None:
+            continue
+        C.all_reduce(g, group=group)
+        div = n if scale is None else scale
+        if div and div != 1:
+            g._assign_array(g._data / div)
+
+
+def fused_allreduce_gradients(parameter_list, hcg):
+    group = hcg.get_data_parallel_group() if hcg is not None else None
+    fused_allreduce_gradients_with_group(parameter_list, group)
+
+
+def _broadcast_params(model, group, fuse_params=True):
+    for _, p in model.named_parameters():
+        C.broadcast(p, src=0, group=group)
+    for _, b in model.named_buffers():
+        C.broadcast(b, src=0, group=group)
+
+
+def broadcast_mp_parameters(model, hcg, fuse_params=True):
+    _broadcast_params(model, hcg.get_model_parallel_group(), fuse_params)
+
+
+def broadcast_dp_parameters(model, hcg, fuse_params=True):
+    _broadcast_params(model, hcg.get_data_parallel_group(), fuse_params)
+
+
+def broadcast_sharding_parameters(model, hcg, fuse_params=True):
+    _broadcast_params(model, hcg.get_sharding_parallel_group(),
+                      fuse_params)
+
+
+def broadcast_sep_parameters(model, hcg, fuse_params=True):
+    _broadcast_params(model, hcg.get_sep_parallel_group(), fuse_params)
+
+
+def broadcast_input_data(hcg, *inputs, **kwargs):
+    """Broadcast batch data across the model-parallel group so every
+    TP rank sees identical inputs (reference :168)."""
+    group = hcg.get_model_parallel_group()
+    from paddle_tpu.core.tensor import Tensor
+    out_in = []
+    for v in inputs:
+        if isinstance(v, Tensor):
+            C.broadcast(v, src=0, group=group)
+        out_in.append(v)
+    for k, v in kwargs.items():
+        if isinstance(v, Tensor):
+            C.broadcast(v, src=0, group=group)
+        kwargs[k] = v
+    return out_in, kwargs

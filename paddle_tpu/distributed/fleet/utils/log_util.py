@@ -1,0 +1,36 @@
+"""Distributed logging helpers (reference fleet/utils/log_util.py)."""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+logger = logging.getLogger("paddle_tpu.fleet")
+if not logger.handlers:
+    _h = logging.StreamHandler(sys.stderr)
+    _h.setFormatter(logging.Formatter(
+        "%(levelname)s %(asctime)s %(name)s: %(message)s"))
+    logger.addHandler(_h)
+logger.setLevel(os.environ.get("FLEET_LOG_LEVEL", "INFO").upper())
+
+
+def set_log_level(level):
+    """INFO/DEBUG/... by name or logging numeric code."""
+    if isinstance(level, str):
+        level = level.upper()
+    logger.setLevel(level)
+
+
+def get_log_level_code():
+    return logger.getEffectiveLevel()
+
+
+def get_log_level_name():
+    return logging.getLevelName(get_log_level_code())
+
+
+def layer_to_str(base, *args, **kwargs):
+    """Format a layer construction call for debug dumps."""
+    parts = [str(a) for a in args]
+    parts += [f"{k}={v}" for k, v in kwargs.items()]
+    return f"{base}({', '.join(parts)})"

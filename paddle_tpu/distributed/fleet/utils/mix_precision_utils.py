@@ -1,0 +1,97 @@
+"""Main-grad mixed-precision wrappers (reference
+fleet/utils/mix_precision_utils.py: MixPrecisionLayer keeps an fp32
+main_grad per parameter accumulated from the low-precision grads;
+MixPrecisionOptimizer steps on the main grads; MixPrecisionScaler
+delegates to the wrapped GradScaler).
+
+TPU design: bf16 params + fp32 master weights already live in
+paddle_tpu.optimizer (multi_precision); these wrappers add the
+main_grad accumulation discipline so hybrid-parallel training can
+accumulate micro-batch grads in fp32 exactly like the reference."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer.layers import Layer
+
+
+class MixPrecisionLayer(Layer):
+    def __init__(self, layers, dtype="bfloat16"):
+        super().__init__()
+        self._layers = layers
+        self._dtype = dtype
+        for _, param in layers.named_parameters():
+            param.main_grad = None
+            param.register_hook(self._make_accum_hook(param))
+
+    @staticmethod
+    def _make_accum_hook(param):
+        def hook(grad):
+            if grad is None:
+                return grad
+            g32 = (grad._data if isinstance(grad, Tensor) else grad) \
+                .astype(jnp.float32)
+            if param.main_grad is None:
+                param.main_grad = Tensor._wrap(g32, True)
+            else:
+                param.main_grad._assign_array(
+                    param.main_grad._data + g32)
+            return grad
+        return hook
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+
+class MixPrecisionOptimizer:
+    """Steps the inner optimizer using each param's fp32 main_grad
+    (reference mix_precision_utils.py:97): main_grad is swapped in as
+    .grad for the step, then cleared."""
+
+    def __init__(self, optimizer):
+        self.__dict__["_inner_opt"] = optimizer
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+    def _params(self):
+        from .hybrid_parallel_util import (
+            obtain_optimizer_parameters_list)
+        return obtain_optimizer_parameters_list(self._inner_opt)
+
+    def step(self):
+        swapped = []
+        for p in self._params():
+            mg = getattr(p, "main_grad", None)
+            if mg is not None:
+                swapped.append((p, p.grad))
+                p.grad = mg
+        self._inner_opt.step()
+        for p, old in swapped:
+            p.grad = old
+            p.main_grad = None
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._params():
+            p.main_grad = None
+        self._inner_opt.clear_grad()
+
+    clear_gradients = clear_grad
+
+
+class MixPrecisionScaler:
+    """Wraps a GradScaler for main-grad training (reference :244); the
+    found-inf scan runs over main_grads via the wrapped scaler."""
+
+    def __init__(self, scaler):
+        self.__dict__["_inner_scaler"] = scaler
+
+    def __getattr__(self, name):
+        return getattr(self._inner_scaler, name)

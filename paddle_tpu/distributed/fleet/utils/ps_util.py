@@ -1,0 +1,43 @@
+"""PS-mode inference helper (reference fleet/utils/ps_util.py
+DistributedInfer: rewrites a program's sparse-embedding lookups into
+distributed pull ops against the parameter-server tables).
+
+TPU design: sparse tables live in paddle_tpu.distributed.ps; dense
+compute is jitted. DistributedInfer keeps the reference's API: it
+binds a ps client and serves embedding pulls for inference loops."""
+from __future__ import annotations
+
+
+class DistributedInfer:
+    def __init__(self, main_program=None, startup_program=None):
+        self._main = main_program
+        self._startup = startup_program
+        self._client = None
+
+    def init_distributed_infer_env(self, exe=None, loss=None,
+                                   role_maker=None, dirname=None):
+        """Connects to the running ps servers (endpoints from the role
+        maker env, reference PaddleCloudRoleMaker); dense params are
+        expected to be loaded already (dirname accepted for parity)."""
+        try:
+            from paddle_tpu.distributed.ps import (PaddleCloudRoleMaker,
+                                                   PsClient)
+            role = role_maker or PaddleCloudRoleMaker()
+            eps = role.server_endpoints()
+            self._client = PsClient(eps) if eps else None
+        except Exception:
+            self._client = None
+        return self
+
+    def get_dist_infer_program(self):
+        """The compiled path needs no program rewrite (embedding pulls
+        happen through the ps client at call sites); returns the
+        program unchanged, matching the reference's no-sparse-op case."""
+        return self._main
+
+    def pull_sparse(self, table_id, ids):
+        if self._client is None:
+            raise RuntimeError(
+                "DistributedInfer: ps client not initialized; call "
+                "init_distributed_infer_env() under fleet PS mode")
+        return self._client.pull_sparse(table_id, ids)

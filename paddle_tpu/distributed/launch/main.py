@@ -23,23 +23,27 @@ def _parse():
                    help="accepted for reference-CLI parity")
     p.add_argument("--log_dir", default=None)
     p.add_argument("--job_id", default="default")
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="elastic relaunch: on worker failure, restart "
+                        "the whole job up to N times (reference: "
+                        "ElasticManager relaunch / launch controllers' "
+                        "replica policy)")
     p.add_argument("script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p.parse_args()
 
 
-def main():
-    args = _parse()
+def _spawn(args, attempt):
     nprocs = args.nproc_per_node
     world = args.nnodes * nprocs
     master = args.master or "127.0.0.1:8476"
-    procs = []
     log_dir = args.log_dir
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
     host = master.rsplit(":", 1)[0]
     base_port = int(master.rsplit(":", 1)[1]) + 1
     endpoints = ",".join(f"{host}:{base_port + r}" for r in range(world))
+    procs = []
     for local in range(nprocs):
         rank = args.rank * nprocs + local
         env = dict(os.environ)
@@ -53,35 +57,63 @@ def main():
             "PADDLE_TRAINER_ENDPOINTS": endpoints,
             "PADDLE_CURRENT_ENDPOINT":
                 f"{host}:{base_port + rank}",
+            "PADDLE_RESTART_COUNT": str(attempt),
         })
         cmd = [sys.executable, args.script] + args.script_args
-        stdout = open(os.path.join(log_dir, f"worker.{rank}.log"), "w") \
+        stdout = open(os.path.join(
+            log_dir, f"worker.{rank}.attempt{attempt}.log"), "w") \
             if log_dir else None
         procs.append((rank, subprocess.Popen(
             cmd, env=env, stdout=stdout,
             stderr=subprocess.STDOUT if stdout else None)))
+    return procs
+
+
+def main():
+    args = _parse()
+    attempt = 0
+    procs = _spawn(args, attempt)
     code = 0
 
     def _kill_all(*_):
         for _, p in procs:
             if p.poll() is None:
                 p.terminate()
+        deadline = time.time() + 5
+        for _, p in procs:
+            try:
+                p.wait(max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()          # reap: no zombies across relaunches
 
-    signal.signal(signal.SIGTERM, _kill_all)
+    signal.signal(signal.SIGTERM, lambda *_: (_kill_all(), sys.exit(143)))
     try:
         while procs:
             alive = []
+            failed = None
             for rank, p in procs:
                 ret = p.poll()
                 if ret is None:
                     alive.append((rank, p))
                 elif ret != 0:
-                    print(f"[launch] worker {rank} exited with {ret}; "
-                          "terminating job", file=sys.stderr)
-                    code = ret
-                    _kill_all()
-                    alive = []
+                    failed = (rank, ret)
                     break
+            if failed is not None:
+                rank, ret = failed
+                _kill_all()
+                if attempt < args.max_restarts:
+                    attempt += 1
+                    print(f"[launch] worker {rank} exited with {ret}; "
+                          f"relaunching job (attempt {attempt}/"
+                          f"{args.max_restarts})", file=sys.stderr)
+                    procs = _spawn(args, attempt)
+                    continue
+                print(f"[launch] worker {rank} exited with {ret}; "
+                      "terminating job", file=sys.stderr)
+                code = ret
+                procs = []
+                break
             procs = alive
             time.sleep(0.2)
     except KeyboardInterrupt:

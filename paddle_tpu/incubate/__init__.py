@@ -12,4 +12,27 @@ from . import autograd  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import distributed  # noqa: F401
 from . import multiprocessing  # noqa: F401
+from . import operators  # noqa: F401
+from . import jit  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
+
+# top-level incubate names (reference incubate/__init__.py __all__)
+from paddle_tpu.geometric import (  # noqa: F401
+    segment_max, segment_mean, segment_min, segment_sum)
+from paddle_tpu.ops.extra import (  # noqa: F401
+    fused_softmax_mask as softmax_mask_fuse,
+    fused_softmax_mask_upper_triangle as softmax_mask_fuse_upper_triangle,
+)
+from .jit import inference  # noqa: F401
+from .nn.loss import identity_loss  # noqa: F401
+from .operators import (  # noqa: F401
+    graph_khop_sampler, graph_reindex, graph_sample_neighbors,
+    graph_send_recv)
+
+__all__ = [
+    'LookAhead', 'ModelAverage', 'graph_khop_sampler', 'graph_reindex',
+    'graph_sample_neighbors', 'graph_send_recv', 'identity_loss',
+    'inference', 'segment_max', 'segment_mean', 'segment_min',
+    'segment_sum', 'softmax_mask_fuse',
+    'softmax_mask_fuse_upper_triangle',
+]

@@ -1,0 +1,48 @@
+"""incubate.jit (reference python/paddle/incubate/jit/: the
+`inference` decorator compiles a Layer's forward / a function for
+fast repeated inference).
+
+TPU design: the reference rewrites the function into a Predictor with
+TensorRT options; here the same decorator lowers onto the one true
+compile path — paddle_tpu.jit.to_static under no_grad — whose executor
+caches the compiled XLA executable per input shape. TRT-specific knobs
+are accepted and ignored (XLA is the optimizing backend)."""
+from __future__ import annotations
+
+import functools
+
+__all__ = ["inference"]
+
+
+def inference(function=None, cache_static_model=False,
+              save_model_dir=None, memory_pool_init_size_mb=1000,
+              precision_mode="float32", switch_ir_optim=True,
+              switch_ir_debug=False, enable_cinn=False,
+              with_trt=False, trt_precision_mode="float32",
+              trt_use_static=False, collect_shape=False,
+              enable_new_ir=False, exp_enable_use_cutlass=False,
+              delete_pass_lists=None, skip_prune_program=False):
+    """Decorator: compile `function` (or a Layer's forward) for
+    inference (reference incubate/jit/inference_decorator.py). All
+    backend-tuning kwargs are accepted for parity; XLA compilation +
+    the executable cache provide the optimization on TPU."""
+    def wrap(fn):
+        from paddle_tpu.jit import to_static
+        import paddle_tpu
+
+        forward = fn.forward if hasattr(fn, "forward") else fn
+        compiled = to_static(forward)
+
+        @functools.wraps(forward)
+        def runner(*args, **kwargs):
+            with paddle_tpu.no_grad():
+                return compiled(*args, **kwargs)
+
+        if hasattr(fn, "forward"):
+            fn.forward = runner
+            return fn
+        return runner
+
+    if function is not None:
+        return wrap(function)
+    return wrap

@@ -1,0 +1,24 @@
+"""incubate.nn.loss (reference python/paddle/incubate/nn/loss.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import run_op
+from paddle_tpu.core.tensor import Tensor
+
+_MODES = {"sum": 0, "mean": 1, "none": 2, 0: 0, 1: 1, 2: 2}
+
+
+def identity_loss(x, reduction="none"):
+    """Marks a tensor as the loss head and applies the reduction
+    (reference incubate/nn/loss.py:36; 'sum'/'mean'/'none' or 0/1/2)."""
+    if reduction not in _MODES:
+        raise ValueError(f"reduction should be sum/mean/none, "
+                         f"got {reduction!r}")
+    mode = _MODES[reduction]
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    if mode == 0:
+        return run_op("identity_loss_sum", lambda a: jnp.sum(a), t)
+    if mode == 1:
+        return run_op("identity_loss_mean", lambda a: jnp.mean(a), t)
+    return run_op("identity_loss", lambda a: a + jnp.zeros((), a.dtype), t)

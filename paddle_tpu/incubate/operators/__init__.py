@@ -1,0 +1,86 @@
+"""Legacy incubate graph operators (reference
+python/paddle/incubate/operators/: graph_send_recv, graph_reindex,
+graph_sample_neighbors, graph_khop_sampler) — thin wrappers over the
+paddle.geometric implementations, kept for drop-in parity with
+reference model code that predates the geometric namespace."""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu import geometric as _geo
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["graph_send_recv", "graph_reindex",
+           "graph_sample_neighbors", "graph_khop_sampler"]
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    return _geo.send_u_recv(x, src_index, dst_index,
+                            reduce_op=pool_type, out_size=out_size)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None,
+                  index_buffer=None, flag_buffer_hashtable=False,
+                  name=None):
+    return _geo.reindex_graph(x, neighbors, count, value_buffer,
+                              index_buffer)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    return _geo.sample_neighbors(row, colptr, input_nodes,
+                                 sample_size=sample_size, eids=eids,
+                                 return_eids=return_eids,
+                                 perm_buffer=perm_buffer)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling (reference
+    incubate/operators/graph_khop_sampler.py): per hop, sample
+    `sample_sizes[k]` neighbors of the frontier, then reindex the
+    union subgraph. Returns (edge_src, edge_dst, sample_index
+    [, edge_eids])."""
+    frontier = input_nodes
+    all_neighbors, all_counts, all_eids = [], [], []
+    seeds = _np(input_nodes)
+    seen = list(seeds.tolist())
+    for k, sz in enumerate(sample_sizes):
+        res = _geo.sample_neighbors(row, colptr, frontier,
+                                    sample_size=sz, eids=sorted_eids,
+                                    return_eids=return_eids)
+        if return_eids:
+            neigh, cnt, eids_k = res
+            all_eids.append(_np(eids_k))
+        else:
+            neigh, cnt = res
+        all_neighbors.append(_np(neigh))
+        all_counts.append(_np(cnt))
+        # next frontier: newly discovered nodes
+        new = [v for v in np.unique(_np(neigh)).tolist()
+               if v not in set(seen)]
+        seen.extend(new)
+        frontier = Tensor(np.asarray(new, seeds.dtype)) if new else \
+            Tensor(np.empty(0, seeds.dtype))
+    neighbors = np.concatenate(all_neighbors) if all_neighbors else \
+        np.empty(0, seeds.dtype)
+    counts = np.concatenate(all_counts) if all_counts else \
+        np.empty(0, np.int32)
+    # counts are per sampled center, in hop order; centers are the
+    # concatenation of per-hop frontiers, which is exactly `seen`
+    # truncated to the number of count entries
+    centers = np.asarray(seen[: len(counts)], seeds.dtype)
+    src, dst, sample_index = _geo.reindex_graph(
+        Tensor(centers), Tensor(neighbors), Tensor(counts))
+    if return_eids:
+        return src, dst, sample_index, Tensor(np.concatenate(all_eids))
+    return src, dst, sample_index
+
+
+def _np(t):
+    if isinstance(t, Tensor):
+        return np.asarray(t.numpy())
+    return np.asarray(t)

@@ -141,7 +141,7 @@ class StaticFunction:
         jitted, out_spec = self._cache[key]
 
         state_arrays = [t._data for t in state]
-        key_in = gen._key
+        key_in = gen._base_key()
         try:
             out_arrays, new_state, new_key = jitted(
                 state_arrays, key_in, arg_arrays)

@@ -672,3 +672,33 @@ def adam_(param, grad, learning_rate, moment1, moment2, beta1_pow,
                   beta2_pow, master_param=master_param, beta1=beta1,
                   beta2=beta2, epsilon=epsilon, coeff=0.0,
                   with_decay=False, multi_precision=multi_precision)
+
+
+def accuracy_check(x, y, fn_name="", rtol=1e-5, atol=1e-8,
+                   equal_nan=False):
+    """Cross-run tensor comparison op (reference accuracy_check,
+    ops.yaml:31, phi/kernels/accuracy_check_kernel.h:29): elementwise
+    allclose(x, y) -> bool tensor; raises with fn_name context when any
+    element mismatches (the reference kernel PADDLE_ENFORCEs)."""
+    def f(a, b):
+        af = a.astype(jnp.float32)
+        bf = b.astype(jnp.float32)
+        # np.isclose semantics: the rtol/atol band applies to finite
+        # pairs only; non-finite values compare by equality (matching
+        # infs pass, inf vs -inf fails — the band would be inf-wide)
+        finite = jnp.isfinite(af) & jnp.isfinite(bf)
+        band = jnp.abs(af - bf) <= (atol + rtol * jnp.abs(bf))
+        close = jnp.where(finite, band, af == bf)
+        if equal_nan:
+            close = close | (jnp.isnan(af) & jnp.isnan(bf))
+        return close
+    out = run_op("accuracy_check", f, _t(x), _t(y))
+    import numpy as _np
+    arr = _np.asarray(out.numpy() if hasattr(out, "numpy") else out)
+    if not arr.all():
+        bad = int(arr.size - arr.sum())
+        raise AssertionError(
+            f"accuracy_check failed for {fn_name or 'tensor'}: "
+            f"{bad}/{arr.size} elements differ "
+            f"(rtol={rtol}, atol={atol})")
+    return out

@@ -75,3 +75,62 @@ def test_launcher_kills_job_on_worker_failure(tmp_path):
     # job fails fast with the worker's code, not after the 30s sleep
     assert proc.returncode == 3, (proc.returncode, proc.stderr[-500:])
     assert time.time() - t0 < 20
+
+
+def test_launcher_relaunches_after_midrun_kill(tmp_path):
+    """Fault injection (reference: ElasticManager relaunch): a worker
+    is SIGKILLed mid-run on the first attempt; with --max_restarts the
+    launcher respawns the whole job with PADDLE_RESTART_COUNT bumped,
+    and the second attempt completes cleanly."""
+    import os
+    import signal as _signal
+    import subprocess
+    import sys
+
+    script = tmp_path / "crasher.py"
+    script.write_text(
+        "import os, signal, time\n"
+        "rank = int(os.environ['PADDLE_TRAINER_ID'])\n"
+        "attempt = int(os.environ['PADDLE_RESTART_COUNT'])\n"
+        "open(f'%s/seen.{rank}.{attempt}', 'w').close()\n"
+        "if rank == 1 and attempt == 0:\n"
+        "    time.sleep(0.3)  # die mid-run, not at startup\n"
+        "    os.kill(os.getpid(), signal.SIGKILL)\n"
+        "time.sleep(0.5)\n" % tmp_path)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo"
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--max_restarts", "2", str(script)],
+        env=env, capture_output=True, timeout=30)
+    assert proc.returncode == 0, (proc.returncode, proc.stderr[-800:])
+    assert b"relaunching job (attempt 1/2)" in proc.stderr
+    # both attempts ran both ranks; attempt 1 finished for rank 1
+    for marker in ("seen.0.0", "seen.1.0", "seen.0.1", "seen.1.1"):
+        assert (tmp_path / marker).exists(), marker
+
+
+def test_launcher_exhausts_restarts(tmp_path):
+    """A deterministic failure stops after max_restarts attempts and
+    propagates the worker's exit code."""
+    import os
+    import subprocess
+    import sys
+
+    script = tmp_path / "alwaysfail.py"
+    script.write_text(
+        "import os, sys\n"
+        "attempt = int(os.environ['PADDLE_RESTART_COUNT'])\n"
+        "open(f'%s/try.{os.environ[\"PADDLE_TRAINER_ID\"]}.{attempt}',"
+        " 'w').close()\n"
+        "sys.exit(7)\n" % tmp_path)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo"
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "1", "--max_restarts", "1", str(script)],
+        env=env, capture_output=True, timeout=30)
+    assert proc.returncode == 7, (proc.returncode, proc.stderr[-500:])
+    assert (tmp_path / "try.0.0").exists()
+    assert (tmp_path / "try.0.1").exists()
+    assert not (tmp_path / "try.0.2").exists()

@@ -1,0 +1,81 @@
+"""Top-level paddle.incubate surface (reference incubate/__init__.py
+__all__) and the legacy graph operators / identity_loss / jit.inference."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import incubate
+
+REF_ALL = ['LookAhead', 'ModelAverage', 'graph_khop_sampler',
+           'graph_reindex', 'graph_sample_neighbors', 'graph_send_recv',
+           'identity_loss', 'inference', 'segment_max', 'segment_mean',
+           'segment_min', 'segment_sum', 'softmax_mask_fuse',
+           'softmax_mask_fuse_upper_triangle']
+
+
+def test_all_matches_reference():
+    assert sorted(incubate.__all__) == sorted(REF_ALL)
+    for name in REF_ALL:
+        assert hasattr(incubate, name), name
+
+
+def test_segment_alias():
+    x = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.]],
+                                  np.float32))
+    ids = paddle.to_tensor(np.array([0, 0, 1]))
+    out = incubate.segment_sum(x, ids)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               [[4., 6.], [5., 6.]])
+
+
+def test_identity_loss_reductions():
+    x = paddle.to_tensor(np.array([1., 2., 3.], np.float32))
+    assert float(incubate.identity_loss(x, "sum").numpy()) == 6.0
+    assert float(incubate.identity_loss(x, 1).numpy()) == 2.0
+    np.testing.assert_allclose(
+        np.asarray(incubate.identity_loss(x, "none").numpy()),
+        [1., 2., 3.])
+    with pytest.raises(ValueError):
+        incubate.identity_loss(x, "bad")
+    # grad flows (it is the loss head)
+    x.stop_gradient = False
+    incubate.identity_loss(x * 2, "sum").backward()
+    np.testing.assert_allclose(np.asarray(x.grad.numpy()), [2., 2., 2.])
+
+
+def test_graph_send_recv_legacy_name():
+    x = paddle.to_tensor(np.array([[1.], [2.], [3.]], np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 2]))
+    dst = paddle.to_tensor(np.array([1, 2, 1]))
+    out = incubate.graph_send_recv(x, src, dst, pool_type="sum")
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               [[0.], [4.], [2.]])
+
+
+def test_graph_khop_sampler_two_hops():
+    # chain graph 0->1->2->3 in CSC (colptr over dst, row = src ids)
+    # edges: (0,1),(1,2),(2,3): row sorted by dst
+    row = paddle.to_tensor(np.array([0, 1, 2]))
+    colptr = paddle.to_tensor(np.array([0, 0, 1, 2, 3]))
+    nodes = paddle.to_tensor(np.array([3]))
+    src, dst, idx = incubate.graph_khop_sampler(row, colptr, nodes,
+                                                [1, 1])
+    idx_v = np.asarray(idx.numpy()).tolist()
+    assert idx_v[0] == 3          # seed first
+    assert set(idx_v) == {3, 2, 1}  # two hops up the chain
+    assert len(np.asarray(src.numpy())) == 2
+
+
+def test_jit_inference_decorator():
+    from paddle_tpu import nn
+    m = nn.Linear(4, 2)
+    x = paddle.to_tensor(np.ones((3, 4), np.float32))
+    ref = np.asarray(m(x).numpy())
+    wrapped = incubate.inference(m)
+    out = np.asarray(wrapped(x).numpy())
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    @incubate.inference
+    def f(t):
+        return t * 2
+    np.testing.assert_allclose(np.asarray(f(x).numpy()), 2 * np.ones((3, 4)))

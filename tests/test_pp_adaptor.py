@@ -1,0 +1,115 @@
+"""PP checkpoint layout conversion (fleet/pp_parallel_adaptor) and the
+accuracy_check cross-run comparison op (reference ops.yaml accuracy_check)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet.pp_parallel_adaptor import (
+    ParallelConfig, PipeLineModelAdaptor, convert_pp_state_dicts)
+
+
+def _make_stage_dicts(num_layers, cfg, with_shared=True):
+    chunks = cfg.stage_chunks(num_layers)
+    dicts = []
+    for s, layer_ids in enumerate(chunks):
+        d = {}
+        for local, g in enumerate(layer_ids):
+            d[f"layers.{local}.w"] = np.full((2,), float(g))
+            d[f"layers.{local}.b"] = np.full((2,), 100.0 + g)
+        if with_shared and s == 0:
+            d["shared_embed.weight"] = np.arange(4.0)
+        dicts.append(d)
+    return dicts
+
+
+def _global_view(stage_dicts, cfg, num_layers):
+    """global layer id -> param dict, via the stage chunk map."""
+    out = {}
+    for d, layer_ids in zip(stage_dicts, cfg.stage_chunks(num_layers)):
+        for local, g in enumerate(layer_ids):
+            out[g] = {k.split(".", 2)[2]: v for k, v in d.items()
+                      if k.startswith(f"layers.{local}.")}
+    return out
+
+
+@pytest.mark.parametrize("src_pp,src_vpp,dst_pp,dst_vpp", [
+    (2, 1, 4, 1),     # widen pipeline
+    (4, 1, 2, 1),     # narrow pipeline
+    (2, 2, 4, 1),     # interleaved VPP -> plain
+    (1, 1, 2, 2),     # single stage -> interleaved
+])
+def test_roundtrip_preserves_global_layers(src_pp, src_vpp, dst_pp,
+                                           dst_vpp):
+    L = 8
+    src = ParallelConfig(src_pp, src_vpp)
+    dst = ParallelConfig(dst_pp, dst_vpp)
+    stage_dicts = _make_stage_dicts(L, src)
+    converted = convert_pp_state_dicts(stage_dicts, src, dst)
+    assert len(converted) == dst_pp
+    # every global layer's params survive with correct values
+    gv = _global_view(converted, dst, L)
+    assert sorted(gv) == list(range(L))
+    for g in range(L):
+        np.testing.assert_array_equal(gv[g]["w"], np.full((2,), float(g)))
+        np.testing.assert_array_equal(gv[g]["b"],
+                                      np.full((2,), 100.0 + g))
+    # shared (non-layer) entries are replicated to all dst stages
+    for d in converted:
+        np.testing.assert_array_equal(d["shared_embed.weight"],
+                                      np.arange(4.0))
+
+
+def test_vpp_interleaving_order():
+    """VPP chunk c of stage s holds layers [(c*pp+s)*per, ...): the
+    reference interleaved assignment."""
+    cfg = ParallelConfig(pp=2, vpp=2)
+    assert cfg.stage_chunks(8) == [[0, 1, 4, 5], [2, 3, 6, 7]]
+
+
+def test_adaptor_class_api():
+    src, dst = ParallelConfig(2), ParallelConfig(4)
+    ad = PipeLineModelAdaptor(src, dst)
+    out = ad.apply(_make_stage_dicts(8, src, with_shared=False))
+    assert len(out) == 4 and all("layers.0.w" in d for d in out)
+    assert all(isinstance(s, str) for s in ad.peek_model(
+        _make_stage_dicts(8, src, with_shared=False)))
+
+
+def test_bad_shapes_raise():
+    with pytest.raises(ValueError):
+        convert_pp_state_dicts([{}, {}], ParallelConfig(3),
+                               ParallelConfig(2))
+    with pytest.raises(ValueError):
+        ParallelConfig(2).stage_chunks(7)
+
+
+class TestAccuracyCheck:
+    def test_pass_and_fail(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.ops.extra import accuracy_check
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        y = paddle.to_tensor(np.array([1.0, 2.0, 3.0 + 1e-7], np.float32))
+        out = accuracy_check(x, y, fn_name="matmul")
+        assert np.asarray(out.numpy()).all()
+        z = paddle.to_tensor(np.array([1.0, 2.0, 4.0], np.float32))
+        with pytest.raises(AssertionError, match="matmul"):
+            accuracy_check(x, z, fn_name="matmul")
+
+    def test_equal_nan(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.ops.extra import accuracy_check
+        x = paddle.to_tensor(np.array([np.nan, 1.0], np.float32))
+        y = paddle.to_tensor(np.array([np.nan, 1.0], np.float32))
+        with pytest.raises(AssertionError):
+            accuracy_check(x, y)
+        assert np.asarray(
+            accuracy_check(x, y, equal_nan=True).numpy()).all()
+
+    def test_matching_infs_are_equal(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.ops.extra import accuracy_check
+        x = paddle.to_tensor(np.array([1.0, np.inf, -np.inf], np.float32))
+        y = paddle.to_tensor(np.array([1.0, np.inf, -np.inf], np.float32))
+        assert np.asarray(accuracy_check(x, y).numpy()).all()
+        z = paddle.to_tensor(np.array([1.0, -np.inf, np.inf], np.float32))
+        with pytest.raises(AssertionError):
+            accuracy_check(x, z)

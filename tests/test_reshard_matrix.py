@@ -1,0 +1,127 @@
+"""Per-transform reshard tests mirroring the reference suite
+(test/auto_parallel/reshard_{r_to_s,s_to_r,s_to_s,p_to_r,nd_mesh,
+*_cross_mesh}.py): each placement transition must preserve values and
+land on the expected sharding. Runs on the 8-device CPU mesh (the
+reference's gloo fake-cluster trick, SURVEY §4.2)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+
+def _mesh(shape, names):
+    return dist.ProcessMesh(shape=list(shape), dim_names=list(names))
+
+
+def _values(t):
+    return np.asarray(t.numpy())
+
+
+def _num_shards(t, dim_size):
+    sh = t._data.sharding
+    return sh.num_devices if hasattr(sh, "num_devices") else dim_size
+
+
+@pytest.fixture(scope="module")
+def data():
+    return np.arange(64, dtype=np.float32).reshape(8, 8)
+
+
+def test_r_to_s(data):
+    mesh = _mesh([4], "x")
+    d = dist.shard_tensor(paddle.to_tensor(data), mesh,
+                          [dist.Replicate()])
+    s = dist.reshard(d, mesh, [dist.Shard(0)])
+    np.testing.assert_array_equal(_values(s), data)
+    assert s.placements is not None and \
+        isinstance(s.placements[0], dist.Shard)
+    assert s.placements[0].get_dim() == 0
+
+
+def test_s_to_r(data):
+    mesh = _mesh([4], "x")
+    s = dist.shard_tensor(paddle.to_tensor(data), mesh, [dist.Shard(0)])
+    r = dist.reshard(s, mesh, [dist.Replicate()])
+    np.testing.assert_array_equal(_values(r), data)
+    assert isinstance(r.placements[0], dist.Replicate)
+
+
+def test_s_to_s_dim_swap(data):
+    """Shard(0) -> Shard(1): the all-to-all transform (reference
+    reshard_s_to_s.py)."""
+    mesh = _mesh([4], "x")
+    s0 = dist.shard_tensor(paddle.to_tensor(data), mesh, [dist.Shard(0)])
+    s1 = dist.reshard(s0, mesh, [dist.Shard(1)])
+    np.testing.assert_array_equal(_values(s1), data)
+    assert s1.placements[0].get_dim() == 1
+
+
+def test_nd_mesh_mixed_placements(data):
+    """2-D mesh with Shard on one axis, Replicate on the other, then
+    flip which axis shards (reference reshard_nd_mesh.py)."""
+    mesh = _mesh([2, 2], ["dp", "mp"])
+    t = dist.shard_tensor(paddle.to_tensor(data), mesh,
+                          [dist.Shard(0), dist.Replicate()])
+    np.testing.assert_array_equal(_values(t), data)
+    flipped = dist.reshard(t, mesh,
+                           [dist.Replicate(), dist.Shard(1)])
+    np.testing.assert_array_equal(_values(flipped), data)
+    pl = flipped.placements
+    assert isinstance(pl[0], dist.Replicate) and \
+        isinstance(pl[1], dist.Shard) and pl[1].get_dim() == 1
+
+
+def test_cross_mesh(data):
+    """Same transform across two DIFFERENT meshes (reference
+    reshard_r_to_s_cross_mesh.py): device_put moves between mesh
+    views."""
+    mesh_a = _mesh([2], "x")
+    mesh_b = _mesh([4], "y")
+    t = dist.shard_tensor(paddle.to_tensor(data), mesh_a,
+                          [dist.Shard(0)])
+    moved = dist.reshard(t, mesh_b, [dist.Shard(1)])
+    np.testing.assert_array_equal(_values(moved), data)
+    assert moved.process_mesh is not None
+    assert tuple(moved.process_mesh.shape) == (4,)
+
+
+def test_partial_is_rejected_on_materialize(data):
+    """Partial is an op-output state, not a materializable placement
+    (our reshard lattice reduces it inside compiled ops)."""
+    mesh = _mesh([4], "x")
+    with pytest.raises(ValueError):
+        dist.shard_tensor(paddle.to_tensor(data), mesh,
+                          [dist.Partial()])
+
+
+def test_grad_flows_through_reshard(data):
+    """reshard is differentiable: grads flow back to the source
+    (reference keeps reshard on the autograd tape)."""
+    mesh = _mesh([4], "x")
+    x = paddle.to_tensor(data)
+    x.stop_gradient = False
+    s = dist.shard_tensor(x, mesh, [dist.Shard(0)])
+    r = dist.reshard(s, mesh, [dist.Replicate()])
+    (r * 2).sum().backward()
+    assert x.grad is not None
+    np.testing.assert_allclose(_values(x.grad),
+                               np.full_like(data, 2.0))
+
+
+def test_reshard_preserves_dtype_under_amp(data):
+    """shard/reshard are data movement, not compute: the AMP O2 hook
+    must not downcast them (they run with amp=False)."""
+    import paddle_tpu as paddle
+    mesh = _mesh([4], "x")
+    x = paddle.to_tensor(data)  # float32
+    with paddle.amp.auto_cast(enable=True, level="O2"):
+        s = dist.shard_tensor(paddle.to_tensor(data), mesh,
+                              [dist.Shard(0)])
+        x2 = paddle.to_tensor(data)
+        x2.stop_gradient = False
+        s2 = dist.shard_tensor(x2, mesh, [dist.Shard(0)])
+        r = dist.reshard(s2, mesh, [dist.Replicate()])
+    assert str(s.dtype).endswith("float32"), s.dtype
+    assert str(s2.dtype).endswith("float32")
+    assert str(r.dtype).endswith("float32")
