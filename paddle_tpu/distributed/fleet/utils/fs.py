@@ -203,7 +203,11 @@ class HDFSClient(FS):
     def rename(self, fs_src_path, fs_dst_path):
         self._run("-mv", fs_src_path, fs_dst_path)
 
-    mv = rename
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False,
+           test_exists=False):
+        if overwrite and self.is_exist(fs_dst_path):
+            self.delete(fs_dst_path)
+        self._run("-mv", fs_src_path, fs_dst_path)
 
     def upload(self, local_path, fs_path):
         self._run("-put", local_path, fs_path)

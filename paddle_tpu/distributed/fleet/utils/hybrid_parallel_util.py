@@ -28,25 +28,21 @@ def unwrap_optimizer(optimizer, optimizer_instances=()):
     return opt
 
 
-def _group_nranks(group):
-    return getattr(group, "nranks", None) or getattr(group, "world_size",
-                                                     1) or 1
-
-
 def fused_allreduce_gradients_with_group(parameter_list, group,
                                          bucket_size=128 * 1024 * 1024,
                                          scale=None):
-    """Allreduce every present grad over `group`, scaling by 1/nranks
-    (the reference scales by the group size after sum)."""
-    n = _group_nranks(group)
+    """Sync every present grad over `group`. The reference sums with
+    NCCL then divides by nranks; in this single-controller stack the
+    collective keeps replicated grads consistent and they are ALREADY
+    the global mean (DataParallel.scale_loss), so no implicit divide —
+    an explicit `scale` is still honored for callers that pre-scaled."""
     for p in parameter_list:
         g = getattr(p, "grad", None)
         if g is None:
             continue
         C.all_reduce(g, group=group)
-        div = n if scale is None else scale
-        if div and div != 1:
-            g._assign_array(g._data / div)
+        if scale and scale != 1:
+            g._assign_array(g._data / scale)
 
 
 def fused_allreduce_gradients(parameter_list, hcg):

@@ -11,7 +11,10 @@ if not logger.handlers:
     _h.setFormatter(logging.Formatter(
         "%(levelname)s %(asctime)s %(name)s: %(message)s"))
     logger.addHandler(_h)
-logger.setLevel(os.environ.get("FLEET_LOG_LEVEL", "INFO").upper())
+try:
+    logger.setLevel(os.environ.get("FLEET_LOG_LEVEL", "INFO").upper())
+except ValueError:
+    logger.setLevel("INFO")   # bad env value must not break imports
 
 
 def set_log_level(level):

@@ -48,6 +48,7 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
     all_neighbors, all_counts, all_eids = [], [], []
     seeds = _np(input_nodes)
     seen = list(seeds.tolist())
+    seen_set = set(seen)
     for k, sz in enumerate(sample_sizes):
         res = _geo.sample_neighbors(row, colptr, frontier,
                                     sample_size=sz, eids=sorted_eids,
@@ -61,8 +62,9 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
         all_counts.append(_np(cnt))
         # next frontier: newly discovered nodes
         new = [v for v in np.unique(_np(neigh)).tolist()
-               if v not in set(seen)]
+               if v not in seen_set]
         seen.extend(new)
+        seen_set.update(new)
         frontier = Tensor(np.asarray(new, seeds.dtype)) if new else \
             Tensor(np.empty(0, seeds.dtype))
     neighbors = np.concatenate(all_neighbors) if all_neighbors else \

@@ -681,17 +681,8 @@ def accuracy_check(x, y, fn_name="", rtol=1e-5, atol=1e-8,
     allclose(x, y) -> bool tensor; raises with fn_name context when any
     element mismatches (the reference kernel PADDLE_ENFORCEs)."""
     def f(a, b):
-        af = a.astype(jnp.float32)
-        bf = b.astype(jnp.float32)
-        # np.isclose semantics: the rtol/atol band applies to finite
-        # pairs only; non-finite values compare by equality (matching
-        # infs pass, inf vs -inf fails — the band would be inf-wide)
-        finite = jnp.isfinite(af) & jnp.isfinite(bf)
-        band = jnp.abs(af - bf) <= (atol + rtol * jnp.abs(bf))
-        close = jnp.where(finite, band, af == bf)
-        if equal_nan:
-            close = close | (jnp.isnan(af) & jnp.isnan(bf))
-        return close
+        return jnp.isclose(a.astype(jnp.float32), b.astype(jnp.float32),
+                           rtol=rtol, atol=atol, equal_nan=equal_nan)
     out = run_op("accuracy_check", f, _t(x), _t(y))
     import numpy as _np
     arr = _np.asarray(out.numpy() if hasattr(out, "numpy") else out)

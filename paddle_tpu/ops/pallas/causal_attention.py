@@ -14,14 +14,17 @@ Combines the two effects measured on v5e:
 fwd saves (o, lse); bwd uses delta = rowsum(do * o) per strip and
 accumulates dk/dv into f32 VMEM refs at static offsets.
 
-MEASURED OUTCOME (v5e, B8/H8/S1024/D128, bench.py e2e): this kernel
-LOSES to the full-S^2 simple_attention kernel — 48.7k tok/s at nq=4,
-49.1k at nq=2, vs 50.6k for simple. A dynamic fori_loop online-softmax
-variant was worse still (44.3k), and a q-block-grid flash variant worst
-(43.9k; ~20us/program grid overhead). Conclusion: at S<=1024 the
-monolithic kernel is VPU/VMEM-bound (exp/mask/casts), not MAC-bound, so
-causal skipping does not pay. Kept as a correct, tested alternative for
-future shapes; deliberately NOT in the flash_attention_maybe dispatch.
+MEASURED OUTCOME (v5e, D128, bf16): shape-dependent.
+- S=1024 (B8): LOSES to the full-S^2 simple_attention kernel — 48.7k
+  tok/s e2e at nq=4, 49.1k at nq=2, vs 50.6k for simple. A dynamic
+  fori_loop online-softmax variant was worse still (44.3k), and a
+  q-block-grid flash variant worst (43.9k; ~20us/program grid
+  overhead). At short S the kernel is VPU/VMEM-bound, not MAC-bound.
+- S=2048 (B4, nq=8): WINS 1.8x over the q-block kernel (4.33 vs 7.85
+  ms/layer fwd+bwd; 41.3k -> 43.8k tok/s e2e) — at long S attention
+  MACs dominate and skipping the upper triangle pays.
+Dispatch (flash_attention_maybe): simple first where it fits
+(S<=1024), then this kernel for causal longer-S, then q-block.
 
 Reference being replaced: phi/kernels/gpu/flash_attn_kernel.cu:587
 (causal path of the CUDA flash-attention v2 wrapper).
@@ -43,7 +46,25 @@ def _pl():
     return pl
 
 
-_NQ = 2
+_NQ = 2   # preferred (fewest, biggest strips); _pick_nq may raise it
+
+
+def _vmem_need(s, d, nq, itemsize):
+    """bwd residency: q/k/v/o/do native + dk/dv f32 + p/dp strips f32."""
+    bq = s // nq
+    return (5 * s * d * itemsize + 2 * s * d * 4
+            + 2 * bq * s * 4 + 8 * s * 4)
+
+
+def _pick_nq(s, d, itemsize, vmem_budget=11 * 2 ** 20):
+    """Smallest nq (widest strips -> best MXU shapes) whose bwd
+    working set fits VMEM. At S=1024 this is 2; at S=2048 the [bq, S]
+    f32 strips force nq=8."""
+    for nq in (_NQ, 4, 8, 16):
+        if s % (nq * 128) == 0 and _vmem_need(s, d, nq, itemsize) \
+                <= vmem_budget:
+            return nq
+    return None
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, sm_scale, bq, nq):
@@ -113,14 +134,8 @@ def supported(q_shape, dtype, vmem_budget=11 * 2 ** 20):
     b, h, s, d = q_shape
     if d % 128 != 0 and d != 64:
         return False
-    if s % (_NQ * 128) != 0:
-        return False
     itemsize = 2 if dtype in (jnp.bfloat16, jnp.float16) else 4
-    bq = s // _NQ
-    # bwd residency: q/k/v/o/do native + dk/dv f32 + p/dp strips f32
-    need = (5 * s * d * itemsize + 2 * s * d * 4
-            + 2 * bq * s * 4 + 8 * s * 4)
-    return need <= vmem_budget
+    return _pick_nq(s, d, itemsize, vmem_budget) is not None
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -129,10 +144,21 @@ def causal_attention(q, k, v, sm_scale, interpret=False):
     return _fwd(q, k, v, sm_scale, interpret)[0]
 
 
+def _require_nq(s, d, dtype):
+    itemsize = 2 if dtype in (jnp.bfloat16, jnp.float16) else 4
+    nq = _pick_nq(s, d, itemsize)
+    if nq is None:
+        raise ValueError(
+            f"causal_attention: shape (S={s}, D={d}, {dtype}) exceeds "
+            "the VMEM budget — check supported() before calling")
+    return nq
+
+
 def _fwd(q, k, v, sm_scale, interpret):
     pl = _pl()
     b, h, s, d = q.shape
-    bq, nq = s // _NQ, _NQ
+    nq = _require_nq(s, d, q.dtype)
+    bq = s // nq
     blk = pl.BlockSpec((1, 1, s, d), lambda i, j: (i, j, 0, 0))
     lblk = pl.BlockSpec((1, 1, 8, s), lambda i, j: (i, j, 0, 0))
     o, lse = pl.pallas_call(
@@ -151,7 +177,8 @@ def _bwd(sm_scale, interpret, res, do):
     pl = _pl()
     q, k, v, o, lse = res
     b, h, s, d = q.shape
-    bq, nq = s // _NQ, _NQ
+    nq = _require_nq(s, d, q.dtype)
+    bq = s // nq
     blk = pl.BlockSpec((1, 1, s, d), lambda i, j: (i, j, 0, 0))
     lblk = pl.BlockSpec((1, 1, 8, s), lambda i, j: (i, j, 0, 0))
     dq, dk, dv = pl.pallas_call(

@@ -67,14 +67,15 @@ def flash_attention_maybe(q, k, v, causal=False, scale=None):
             return None
         if not _supported(q, k, v):
             return None
+        from paddle_tpu.ops.pallas import causal_attention as cak
         from paddle_tpu.ops.pallas import simple_attention as sa
         from paddle_tpu.ops.pallas import simple_attention2 as sa2
-        # NOTE: ops/pallas/causal_attention.py (blockwise causal-skip)
-        # was measured SLOWER end-to-end than the full-S^2 simple
-        # kernel at S=1024 on v5e (48.7-49.1k vs 50.6k tok/s) — the
-        # kernel is VPU/VMEM-bound, not MAC-bound, so skipping the
-        # upper triangle does not pay. It stays available as an op
-        # but is deliberately not in this dispatch chain.
+        # Dispatch order (v5e measurements): at S<=1024 the full-S^2
+        # monolithic kernel wins (VPU-bound; causal skipping does not
+        # pay: 49.1k vs 50.6k tok/s e2e). Where the whole [S,S] score
+        # matrix no longer fits (S=2048), the causal-skip strip kernel
+        # beats the q-block kernel ~1.8x (4.33 vs 7.85 ms/layer
+        # fwd+bwd) because attention MACs dominate at long S.
         bhsd = (q.shape[0], q.shape[2], q.shape[1], q.shape[3])
         if q.shape[1] == k.shape[1] and sa.supported(bhsd, q.dtype):
             qt = jnp.swapaxes(q, 1, 2)
@@ -82,6 +83,14 @@ def flash_attention_maybe(q, k, v, causal=False, scale=None):
             vt = jnp.swapaxes(v, 1, 2)
             out = sa.attention_bhsd(qt, kt, vt, causal=causal,
                                     scale=scale)
+            return jnp.swapaxes(out, 1, 2)
+        if causal and q.shape[1] == k.shape[1] \
+                and cak.supported(bhsd, q.dtype):
+            qt = jnp.swapaxes(q, 1, 2)
+            kt = jnp.swapaxes(k, 1, 2)
+            vt = jnp.swapaxes(v, 1, 2)
+            out = cak.attention_bhsd(qt, kt, vt, causal=True,
+                                     scale=scale)
             return jnp.swapaxes(out, 1, 2)
         if q.shape[1] == k.shape[1] and sa2.supported(bhsd, q.dtype):
             # middle tier: q streams in blocks, k/v whole in VMEM

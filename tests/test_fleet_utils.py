@@ -114,3 +114,27 @@ def test_distributed_infer_requires_ps():
     di = DistributedInfer().init_distributed_infer_env()
     with pytest.raises(RuntimeError):
         di.pull_sparse(0, np.array([1, 2]))
+
+
+def test_fused_allreduce_no_implicit_divide():
+    """Single-controller semantics: grads are already the global mean,
+    so a multi-rank group must NOT shrink them (the reference's
+    sum-then-divide discipline does not carry over)."""
+    from paddle_tpu.distributed.fleet.utils.hybrid_parallel_util import (
+        fused_allreduce_gradients_with_group)
+
+    class FakeGroup:
+        nranks = 4
+        world_size = 4
+    m = nn.Linear(4, 4)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    m(x).sum().backward()
+    g0 = np.asarray(m.weight.grad.numpy()).copy()
+    fused_allreduce_gradients_with_group(list(m.parameters()),
+                                         group=FakeGroup())
+    np.testing.assert_allclose(np.asarray(m.weight.grad.numpy()), g0)
+    # explicit pre-scale is honored
+    fused_allreduce_gradients_with_group(list(m.parameters()),
+                                         group=FakeGroup(), scale=2.0)
+    np.testing.assert_allclose(np.asarray(m.weight.grad.numpy()),
+                               g0 / 2.0)
