@@ -42,13 +42,16 @@ def main():
                         num_heads=4, max_seq_len=128)
         batch, seq, steps, warmup = 2, 128, 3, 1
     else:
-        # GPT-medium-class (~350M params) — fits v5e 16GB with remat.
-        # 8 heads x 128-dim (same params as 16x64): head_dim 128 keeps
-        # the MXU lanes full; 16x64 costs ~1.8ms/layer extra in the
-        # flash kernel (benchmarks/_attn_d128.py)
-        cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
-                        num_heads=8, max_seq_len=1024)
-        batch, seq, steps, warmup = 8, 1024, 10, 2
+        # GPT-1.3B class — the BASELINE.json north-star model ("GPT-3
+        # 1.3B pretrain, per-chip tokens/sec"). h=2048, 16x128 heads
+        # (head_dim 128 keeps the MXU lanes full), B4/S1024 with the
+        # "names" remat policy fits v5e 16GB; measured 14.8k tok/s =
+        # 1.007x the A100@40%MFU proxy. B8 exceeds memory (compile
+        # fails); the smaller 350M config runs at 0.96-0.99x
+        # (benchmarks/_perf_sweep.py history).
+        cfg = GPTConfig(vocab_size=50304, hidden_size=2048, num_layers=24,
+                        num_heads=16, max_seq_len=1024)
+        batch, seq, steps, warmup = 4, 1024, 8, 2
     # scan_unroll=num_layers buys ~3% more but makes the remote-compile
     # path flaky (huge HLO); keep the reliable rolled loop here
     pcfg = ParallelConfig(dp=1, pp=1, tp=1, remat=True,
@@ -93,7 +96,7 @@ def main():
     flops_per_token = 6 * n_params + 12 * L * h * s
     a100_baseline = 0.4 * 312e12 / flops_per_token
     print(json.dumps({
-        "metric": "gpt350m_train_tokens_per_sec_per_chip"
+        "metric": "gpt1.3b_train_tokens_per_sec_per_chip"
         if not on_cpu else "gpt_tiny_cpu_tokens_per_sec",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",

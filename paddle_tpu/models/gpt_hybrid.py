@@ -51,6 +51,10 @@ class ParallelConfig:
     # "dots" saves matmul/einsum outputs and recomputes only elementwise
     # (cuts the ~1/3 recompute FLOPs of full remat at modest memory cost)
     remat_policy: str = "full"
+    # names saved by the "names" policy (v5e-tuned: saving MORE than
+    # these hurts via memory pressure, fewer recomputes the flash
+    # kernel in backward)
+    remat_save_names: tuple = ("attn_out", "ffn1", "qkv")
     zero1: bool = True        # shard adam moments over dp
     fused_ce: bool = True     # chunked LM-head+CE (ops/fused_ce.py);
                               # never materializes [T, V] logits
@@ -263,7 +267,7 @@ def _stack_apply(blocks, x, cfg, pcfg, mesh):
                 # proj/ffn2 as well LOWERS throughput (memory pressure)
                 fn = jax.checkpoint(
                     fn, policy=jax.checkpoint_policies
-                    .save_only_these_names("attn_out", "ffn1", "qkv"))
+                    .save_only_these_names(*pcfg.remat_save_names))
             else:
                 fn = jax.checkpoint(fn)
         return fn(h, lp), None
