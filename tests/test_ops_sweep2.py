@@ -1,0 +1,217 @@
+"""Second data-driven single-op numeric tranche (same OpTest harness
+as test_ops_sweep.py; reference mechanism test/legacy_test per-op
+files): special functions, search/sort, indexing, linalg solves,
+logic/bitwise, and histogram-family ops vs numpy/scipy oracles."""
+import numpy as np
+import pytest
+import scipy.special as sps
+
+import paddle_tpu as paddle
+from op_test import OpTest
+
+rng = np.random.RandomState(11)
+
+
+def T(shape, dtype=np.float32, lo=-2.0, hi=2.0):
+    return (rng.uniform(lo, hi, shape)).astype(dtype)
+
+
+def POS(shape, dtype=np.float32):
+    return rng.uniform(0.2, 3.0, shape).astype(dtype)
+
+
+def SPD(n):
+    a = T((n, n)) * 0.3
+    return (a @ a.T + n * np.eye(n, dtype=np.float32))
+
+
+I32 = lambda *v: np.asarray(v, np.int32)
+
+
+# (name, op, ref, inputs, attrs, check_grad)
+CASES = [
+    # special functions
+    ("i1", paddle.i1, sps.i1, {"x": T((8,))}, {}, True),
+    ("i0e", paddle.i0e, sps.i0e, {"x": T((8,))}, {}, True),
+    ("i1e", paddle.i1e, sps.i1e, {"x": T((8,))}, {}, True),
+    ("polygamma", paddle.polygamma,
+     lambda x, n: sps.polygamma(n, x), {"x": POS((6,))}, {"n": 1},
+     False),
+    ("gammaln", paddle.gammaln, sps.gammaln, {"x": POS((8,))}, {},
+     True),
+    ("exp", paddle.exp, np.exp, {"x": T((8,))}, {}, True),
+    ("tanh", paddle.tanh, np.tanh, {"x": T((8,))}, {}, True),
+    ("pow", paddle.pow, lambda x, y: np.power(x, y),
+     {"x": POS((6,))}, {"y": 2.5}, True),
+    # sorting / searching
+    ("sort", paddle.sort, lambda x, axis: np.sort(x, axis),
+     {"x": T((4, 5))}, {"axis": 1}, True),
+    ("argsort", paddle.argsort, lambda x, axis: np.argsort(
+        x, axis, kind="stable"), {"x": T((4, 5))}, {"axis": 1}, False),
+    ("argmax", paddle.argmax, lambda x, axis: np.argmax(x, axis),
+     {"x": T((4, 5))}, {"axis": 1}, False),
+    ("argmin", paddle.argmin, lambda x, axis: np.argmin(x, axis),
+     {"x": T((4, 5))}, {"axis": 0}, False),
+    ("topk", lambda x, k: paddle.topk(x, k)[0],
+     lambda x, k: np.sort(x, -1)[..., ::-1][..., :k],
+     {"x": T((3, 7))}, {"k": 3}, True),
+    ("kthvalue", lambda x, k: paddle.kthvalue(x, k)[0],
+     lambda x, k: np.sort(x, -1)[..., k - 1],
+     {"x": T((3, 7))}, {"k": 2}, False),
+    ("mode", lambda x: paddle.mode(x)[0],
+     lambda x: np.array([1., 2.], np.float32),
+     {"x": np.array([[1., 1., 3.], [2., 2., 0.]], np.float32)}, {},
+     False),
+    ("searchsorted", paddle.searchsorted,
+     lambda s, v: np.searchsorted(s, v).astype(np.int64),
+     {"sorted_sequence": np.sort(T((8,))), "values": T((5,))}, {},
+     False),
+    ("bucketize", paddle.bucketize,
+     lambda x, s: np.searchsorted(s, x).astype(np.int64),
+     {"x": T((6,)), "sorted_sequence": np.sort(T((5,)))}, {}, False),
+    # indexing / gather-scatter
+    ("index_select", paddle.index_select,
+     lambda x, index, axis: np.take(x, index, axis),
+     {"x": T((4, 5)), "index": I32(0, 2, 2)}, {"axis": 0}, True),
+    ("take_along_axis", paddle.take_along_axis,
+     lambda arr, indices, axis: np.take_along_axis(
+         arr, indices.astype(np.int64), axis),
+     {"arr": T((3, 4)), "indices": rng.randint(0, 4, (3, 2))
+      .astype(np.int64)}, {"axis": 1}, True),
+    ("gather", paddle.gather,
+     lambda x, index: np.take(x, index, 0),
+     {"x": T((5, 3)), "index": I32(1, 3)}, {}, True),
+    ("gather_nd", paddle.gather_nd,
+     lambda x, index: x[tuple(index.T)],
+     {"x": T((4, 3)), "index": np.array([[0], [2]], np.int64)}, {},
+     True),
+    ("scatter", paddle.scatter,
+     lambda x, index, updates: _scatter_ref(x, index, updates),
+     {"x": T((5, 3)), "index": I32(1, 3),
+      "updates": T((2, 3))}, {}, False),
+    ("index_add",
+     lambda x, index, value, axis: paddle.index_add(x, index, axis,
+                                                    value),
+     lambda x, index, value, axis: _index_add_ref(x, index, value),
+     {"x": T((5, 3)), "index": I32(0, 2), "value": T((2, 3))},
+     {"axis": 0}, False),
+    ("take", paddle.take, lambda x, index: np.take(x, index),
+     {"x": T((4, 3)), "index": I32(0, 5, 11)}, {}, False),
+    ("repeat_interleave", paddle.repeat_interleave,
+     lambda x, repeats, axis: np.repeat(x, repeats, axis),
+     {"x": T((3, 2))}, {"repeats": 2, "axis": 0}, True),
+    ("tile", paddle.tile, lambda x, repeat_times: np.tile(
+        x, repeat_times), {"x": T((2, 3))}, {"repeat_times": (2, 1)},
+     True),
+    ("diag", paddle.diag, np.diag, {"x": T((4,))}, {}, True),
+    ("diag_embed", paddle.diag_embed,
+     lambda x: np.stack([np.diag(r) for r in x]),
+     {"x": T((3, 4))}, {}, False),
+    ("flatten", paddle.flatten, lambda x: x.reshape(-1),
+     {"x": T((2, 3, 4))}, {}, True),
+    # linalg
+    ("solve", paddle.linalg.solve, np.linalg.solve,
+     {"x": SPD(4), "y": T((4, 2))}, {}, True),
+    ("cholesky", paddle.linalg.cholesky,
+     lambda x: np.linalg.cholesky(x), {"x": SPD(4)}, {}, False),
+    ("triangular_solve", paddle.linalg.triangular_solve,
+     lambda x, y: np.linalg.solve(np.triu(x), y),
+     {"x": SPD(3), "y": T((3, 2))}, {}, False),
+    ("det", paddle.linalg.det, np.linalg.det, {"x": SPD(3)}, {},
+     True),
+    ("inv", paddle.linalg.inv, np.linalg.inv, {"x": SPD(3)}, {},
+     True),
+    ("pinv", paddle.linalg.pinv, np.linalg.pinv, {"x": T((4, 3))},
+     {}, False),
+    ("eigvalsh", lambda x: paddle.linalg.eigvalsh(x),
+     lambda x: np.linalg.eigvalsh(x), {"x": SPD(4)}, {}, False),
+    ("matrix_rank", paddle.linalg.matrix_rank,
+     lambda x: np.int64(np.linalg.matrix_rank(x)), {"x": SPD(3)}, {},
+     False),
+    ("norm_fro", paddle.linalg.norm, lambda x: np.linalg.norm(x),
+     {"x": T((3, 4))}, {}, True),
+    ("cond", paddle.linalg.cond,
+     lambda x: np.float32(np.linalg.cond(x)), {"x": SPD(3)}, {},
+     False),
+    ("matmul", paddle.matmul, np.matmul,
+     {"x": T((3, 4)), "y": T((4, 5))}, {}, True),
+    ("bmm", paddle.bmm, np.matmul,
+     {"x": T((2, 3, 4)), "y": T((2, 4, 2))}, {}, True),
+    ("mv", paddle.mv, np.matmul, {"x": T((3, 4)), "y": T((4,))}, {},
+     True),
+    ("dist", paddle.dist,
+     lambda x, y, p: np.float32(np.linalg.norm((x - y).ravel(), p)),
+     {"x": T((3, 4)), "y": T((3, 4))}, {"p": 2}, True),
+    # logic / comparison / bitwise
+    ("isclose", paddle.isclose, np.isclose,
+     {"x": T((6,)), "y": T((6,))}, {}, False),
+    ("equal", paddle.equal, np.equal,
+     {"x": I32(1, 2, 3), "y": I32(1, 0, 3)}, {}, False),
+    ("greater_than", paddle.greater_than, np.greater,
+     {"x": T((6,)), "y": T((6,))}, {}, False),
+    ("logical_and", paddle.logical_and, np.logical_and,
+     {"x": np.array([True, False, True]),
+      "y": np.array([True, True, False])}, {}, False),
+    ("logical_xor", paddle.logical_xor, np.logical_xor,
+     {"x": np.array([True, False, True]),
+      "y": np.array([True, True, False])}, {}, False),
+    ("bitwise_and", paddle.bitwise_and, np.bitwise_and,
+     {"x": I32(5, 6, 7), "y": I32(3, 3, 3)}, {}, False),
+    ("bitwise_xor", paddle.bitwise_xor, np.bitwise_xor,
+     {"x": I32(5, 6, 7), "y": I32(3, 3, 3)}, {}, False),
+    ("isfinite", paddle.isfinite, np.isfinite,
+     {"x": np.array([1.0, np.inf, np.nan], np.float32)}, {}, False),
+    ("isnan", paddle.isnan, np.isnan,
+     {"x": np.array([1.0, np.inf, np.nan], np.float32)}, {}, False),
+    # histogram family / misc
+    ("bincount", paddle.bincount,
+     lambda x: np.bincount(x).astype(np.int64),
+     {"x": np.array([0, 1, 1, 3], np.int64)}, {}, False),
+    ("histogram", lambda x: paddle.histogram(x, bins=4, min=0, max=4),
+     lambda x: np.histogram(x, bins=4, range=(0, 4))[0].astype(
+         np.int64), {"x": T((20,), lo=0, hi=4)}, {}, False),
+    ("cummax", lambda x: paddle.cummax(x, axis=0)[0],
+     lambda x: np.maximum.accumulate(x, 0), {"x": T((6,))}, {}, True),
+    ("cummin", lambda x: paddle.cummin(x, axis=0)[0],
+     lambda x: np.minimum.accumulate(x, 0), {"x": T((6,))}, {}, True),
+    ("vander", paddle.vander, lambda x: np.vander(x),
+     {"x": T((4,))}, {}, False),
+    ("trapezoid", paddle.trapezoid,
+     lambda y, dx: np.float32(np.trapezoid(y, dx=dx)
+                              if hasattr(np, "trapezoid")
+                              else np.trapz(y, dx=dx)),
+     {"y": T((7,))}, {"dx": 0.5}, True),
+    ("pdist_like_cdist", paddle.cdist,
+     lambda x, y: _cdist_ref(x, y),
+     {"x": T((3, 4)), "y": T((5, 4))}, {}, False),
+]
+
+
+def _scatter_ref(x, index, updates):
+    out = x.copy()
+    out[index] = updates
+    return out
+
+
+def _index_add_ref(x, index, value):
+    out = x.copy()
+    np.add.at(out, index, value)
+    return out
+
+
+def _cdist_ref(x, y):
+    return np.sqrt(((x[:, None, :] - y[None, :, :]) ** 2).sum(-1))
+
+
+@pytest.mark.parametrize("case", CASES, ids=[c[0] for c in CASES])
+def test_op_numeric2(case):
+    name, op, ref, inputs, attrs, grad = case
+    cls = type(f"T_{name}", (OpTest,), {
+        "op": staticmethod(op), "ref": staticmethod(ref),
+        "inputs": inputs, "attrs": attrs,
+        "rtol": 2e-4, "atol": 1e-5,
+    })
+    t = cls()
+    t.check_output()
+    if grad:
+        t.check_grad()
