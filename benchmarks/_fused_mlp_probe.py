@@ -1,0 +1,77 @@
+# Fwd-only probe: Pallas block-tiled fused MLP (x@W1 -> gelu -> @W2,
+# [M,4H] intermediate stays in VMEM) vs the XLA two-matmul chain.
+import sys; sys.path.insert(0, "/root/repo")
+import functools, time
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+
+def _pl():
+    from jax.experimental import pallas as pl
+    return pl
+
+def fused_mlp_fwd(x, w1, w2, bm=256, bn=256):
+    pl = _pl()
+    M, H = x.shape
+    N = w1.shape[1]
+    xblk = pl.BlockSpec((bm, H), lambda i, j: (i, 0))
+    w1blk = pl.BlockSpec((H, bn), lambda i, j: (0, j))
+    w2blk = pl.BlockSpec((bn, H), lambda i, j: (j, 0))
+    oblk = pl.BlockSpec((bm, H), lambda i, j: (i, 0))
+
+    def kernel(x_ref, w1_ref, w2_ref, o_ref):
+        j = pl.program_id(1)
+        mid = lax.dot_general(
+            x_ref[...].astype(jnp.float32), w1_ref[...].astype(jnp.float32),
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        mid = jax.nn.gelu(mid).astype(x_ref.dtype)
+        contrib = lax.dot_general(
+            mid, w2_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+        @pl.when(j == 0)
+        def _init():
+            o_ref[...] = contrib.astype(o_ref.dtype)
+
+        @pl.when(j > 0)
+        def _acc():
+            o_ref[...] += contrib.astype(o_ref.dtype)
+
+    return pl.pallas_call(
+        kernel, grid=(M // bm, N // bn),
+        in_specs=[xblk, w1blk, w2blk],
+        out_specs=oblk,
+        out_shape=jax.ShapeDtypeStruct((M, H), jnp.float32),
+    )(x, w1, w2)
+
+def timeit(name, fn, *args, steps=30, warmup=5):
+    f = jax.jit(fn)
+    for _ in range(warmup): out = f(*args)
+    np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0]))
+    t0 = time.perf_counter()
+    for _ in range(steps): out = f(*args)
+    np.asarray(jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0]))
+    dt = (time.perf_counter() - t0) / steps
+    fl = 2 * 2 * M * H * N
+    print(f"{name}: {dt*1e3:.3f} ms  {fl/dt/1e12:.1f} TF/s ({fl/dt/197e12*100:.0f}%)", flush=True)
+
+if __name__ == "__main__":
+    M, H = 4096, 2048
+    N = 4 * H
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (M, H), jnp.bfloat16) * 0.3
+    w1 = jax.random.normal(key, (H, N), jnp.bfloat16) * 0.02
+    w2 = jax.random.normal(key, (N, H), jnp.bfloat16) * 0.02
+    a = jax.jit(lambda x: jax.nn.gelu((x @ w1).astype(jnp.float32)).astype(jnp.bfloat16) @ w2)(x)
+    b = jax.jit(lambda x: fused_mlp_fwd(x, w1, w2))(x)
+    print("max err:", float(jnp.abs(a.astype(jnp.float32) - b).max()))
+    timeit("xla chain", lambda x: jax.nn.gelu((x @ w1).astype(jnp.float32)).astype(jnp.bfloat16) @ w2, x)
+    timeit("pallas fused", lambda x: fused_mlp_fwd(x, w1, w2), x)
+
+# MEASURED (v5e, M=4096 H=2048 N=8192, bm=256/bn=256 — largest tiles
+# that fit VMEM with double buffering): xla chain 4.724 ms vs pallas
+# fused 5.538 ms. The fused version loses: 256-tile second matmul has
+# weak MXU shape (K=bn) and the f32 o_ref += across 32 j-steps
+# serializes. The [M,4H] HBM round-trip it saves (~0.16 ms/layer) is
+# smaller than the tiling penalty. NEGATIVE RESULT — do not pursue
+# without a smarter schedule (e.g. K-major accumulation in registers).
