@@ -20,16 +20,8 @@ import numpy as np
 
 def main():
     if os.environ.get("BENCH_CPU") == "1":
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-        import jax._src.xla_bridge as xb
-        xb._backend_factories.pop("axon", None)
-        xb._backend_factories.pop("tpu", None)
-        f = xb._get_backend_uncached
-        if getattr(f, "__name__", "") == "_axon_get_backend_uncached" \
-                and f.__closure__:
-            xb._get_backend_uncached = f.__closure__[0].cell_contents
+        from paddle_tpu._testing import force_cpu
+        force_cpu(pop_tpu=True)
     import jax
     import jax.numpy as jnp
 

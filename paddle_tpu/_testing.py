@@ -1,0 +1,51 @@
+"""Test/bench environment helpers.
+
+This box's axon sitecustomize registers a tunneled-TPU PJRT backend whose
+client creation can block when the tunnel is unhealthy; CPU-only runs
+(tests, bench smoke, subprocess workers) must neutralize it BEFORE the
+first jax operation. This is the single home for that private-API
+surgery — conftest.py, bench.py, and spawned worker scripts all import
+it so a jax upgrade only needs one fix.
+"""
+from __future__ import annotations
+
+import os
+
+
+def unshim_axon(pop_tpu: bool = False) -> None:
+    """Remove the axon backend factory and restore jax's original
+    backend lookup. Call after `import jax` but before the first op.
+
+    pop_tpu: also unregister the tpu factory (bench CPU smoke). Tests
+    keep it registered — JAX_PLATFORMS=cpu already prevents creation,
+    and unregistering would break importing pallas kernels.
+    """
+    import jax._src.xla_bridge as xb
+
+    xb._backend_factories.pop("axon", None)
+    if pop_tpu:
+        xb._backend_factories.pop("tpu", None)
+    f = xb._get_backend_uncached
+    if getattr(f, "__name__", "") == "_axon_get_backend_uncached" \
+            and f.__closure__:
+        xb._get_backend_uncached = f.__closure__[0].cell_contents
+
+
+def force_cpu(num_devices: int | None = None,
+              pop_tpu: bool = False) -> None:
+    """Full CPU-backend setup for a fresh process: env + jax config +
+    unshim. Must run before the first jax operation; num_devices > 1
+    adds the virtual-device XLA flag (only effective if jax hasn't
+    created a backend yet)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if num_devices and num_devices > 1:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags +
+                f" --xla_force_host_platform_device_count={num_devices}"
+            ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    unshim_axon(pop_tpu=pop_tpu)

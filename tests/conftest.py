@@ -18,16 +18,12 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-import jax._src.xla_bridge as _xb  # noqa: E402
+# the "tpu" factory stays registered (pop_tpu=False) — JAX_PLATFORMS=cpu
+# already prevents backend creation, and popping it unregisters the
+# "tpu" platform from MLIR, which breaks importing pallas kernels
+from paddle_tpu._testing import unshim_axon  # noqa: E402
 
-_xb._backend_factories.pop("axon", None)
-# NOTE: the "tpu" factory stays registered — JAX_PLATFORMS=cpu already
-# prevents backend creation, and popping it unregisters the "tpu"
-# platform from MLIR, which breaks importing pallas kernels in tests.
-_f = _xb._get_backend_uncached
-if getattr(_f, "__name__", "") == "_axon_get_backend_uncached" \
-        and _f.__closure__:
-    _xb._get_backend_uncached = _f.__closure__[0].cell_contents
+unshim_axon(pop_tpu=False)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,96 @@
+"""REAL multi-process collective test (reference mechanism: SURVEY §4.2
+CommunicationTestDistBase — shell out to the launcher, run N worker
+processes on localhost, assert per-rank numerical equality; gloo-on-CPU
+is the reference's transport, the JAX coordination service + XLA:CPU
+collectives are ours).
+
+This exercises the paths that the single-process suite cannot: the
+distributed/env.py jax.distributed bootstrap (PADDLE_MASTER →
+coordinator), cross-process device visibility (2 processes × 1 CPU
+device = a 2-device global mesh), a cross-process allgather, and the
+multihost barrier."""
+import os
+import socket
+import subprocess
+import sys
+
+WORKER = r'''
+from paddle_tpu._testing import force_cpu
+force_cpu()
+import jax
+import numpy as np
+import paddle_tpu.distributed as dist
+
+group = dist.init_parallel_env()
+rank = dist.get_rank()
+world = dist.get_world_size()
+assert world == 2, f"world={world}"
+assert group.nranks == 2 and group.rank == rank
+assert len(jax.devices()) == 2, jax.devices()      # global view
+assert len(jax.local_devices()) == 1               # one per process
+
+from jax.experimental import multihost_utils
+got = multihost_utils.process_allgather(
+    np.array([float(rank + 1)], np.float32))
+np.testing.assert_allclose(np.asarray(got).ravel(), [1.0, 2.0])
+
+# compiled SPMD collective across the two processes: shard a global
+# [2, 4] batch over the process-spanning mesh and psum it
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+local = np.full((1, 4), float(rank + 1), np.float32)
+garr = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("dp", None)), local, (2, 4))
+
+@jax.jit
+def summed(x):
+    return shard_map(lambda t: jax.lax.psum(t, "dp"), mesh=mesh,
+                     in_specs=P("dp", None), out_specs=P())(x)
+
+out = summed(garr)
+# out is replicated: every process's addressable shard holds the sum
+np.testing.assert_allclose(
+    np.asarray(out.addressable_data(0)).ravel()[:4],
+    [3.0] * 4)    # 1 + 2 summed over the dp axis
+
+dist.barrier()
+open(os.environ["MARKER_DIR"] + f"/ok.{rank}", "w").close()
+print(f"rank {rank} OK", flush=True)
+'''
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_bootstrap_and_allgather(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo"
+    env["MARKER_DIR"] = str(tmp_path)
+    env.pop("XLA_FLAGS", None)             # exactly 1 CPU device/proc
+    port = _free_port()
+    # start_new_session + killpg: on timeout the worker grandchildren
+    # must die with the launcher (SIGKILLing only the launcher would
+    # orphan workers blocked in jax.distributed.initialize)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--master", f"127.0.0.1:{port}",
+         str(script)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        start_new_session=True)
+    try:
+        _, stderr = proc.communicate(timeout=120)
+    except subprocess.TimeoutExpired:
+        os.killpg(proc.pid, 9)
+        proc.wait()
+        raise
+    assert proc.returncode == 0, stderr[-1200:]
+    assert (tmp_path / "ok.0").exists() and (tmp_path / "ok.1").exists()
