@@ -16,12 +16,26 @@ distributed.checkpoint path.
 from __future__ import annotations
 
 import re
+import warnings
 from typing import Dict, List, Sequence
 
 __all__ = ["ParallelConfig", "PipeLineModelAdaptor",
            "convert_pp_state_dicts"]
 
 _LAYER_RE = re.compile(r"^layers\.(\d+)\.(.+)$")
+
+
+def _values_equal(a, b) -> bool:
+    import numpy as np
+    try:
+        a, b = np.asarray(a), np.asarray(b)
+    except Exception:
+        return a is b
+    try:
+        # NaN-containing replicas are still replicas
+        return bool(np.array_equal(a, b, equal_nan=True))
+    except TypeError:   # equal_nan unsupported for this dtype
+        return bool(np.array_equal(a, b))
 
 
 class ParallelConfig:
@@ -80,9 +94,12 @@ def convert_pp_state_dicts(stage_dicts: Sequence[Dict],
     """Re-partition per-stage state dicts from layout src to dst.
 
     Layer params are renamed through global layer ids; non-layer
-    entries (shared embeddings, final norm, ...) are given to every
-    destination stage that got any layer from the source stage holding
-    them, with first-seen winning (they are replicas)."""
+    entries (shared embeddings, final norm, ...) are replicated to
+    EVERY destination stage — a stage model that does not reference an
+    entry simply ignores it, while tied-embedding stages (first/last)
+    always find their copy. Same-named entries held by several source
+    stages are treated as replicas (first seen wins); a warning is
+    emitted if the replicas are not numerically identical."""
     if len(stage_dicts) != src.pp:
         raise ValueError(f"expected {src.pp} stage dicts, "
                          f"got {len(stage_dicts)}")
@@ -98,7 +115,14 @@ def convert_pp_state_dicts(stage_dicts: Sequence[Dict],
         by_layer, extra = _split_stage_dict(stage_dict, layer_ids)
         global_params.update(by_layer)
         for k, v in extra.items():
-            passthrough.setdefault(k, v)
+            if k in passthrough:
+                if not _values_equal(passthrough[k], v):
+                    warnings.warn(
+                        f"non-layer checkpoint entry {k!r} appears in "
+                        "multiple source stages with different values; "
+                        "keeping the first-seen copy")
+            else:
+                passthrough[k] = v
 
     out: List[Dict] = []
     for layer_ids in dst_chunks:

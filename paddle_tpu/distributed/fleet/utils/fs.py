@@ -204,9 +204,16 @@ class HDFSClient(FS):
         self._run("-mv", fs_src_path, fs_dst_path)
 
     def mv(self, fs_src_path, fs_dst_path, overwrite=False,
-           test_exists=False):
+           test_exists=True):
+        # reference fs.py:1033 — overwrite-delete first, then the
+        # existence checks (src must exist, dst must not)
         if overwrite and self.is_exist(fs_dst_path):
             self.delete(fs_dst_path)
+        if test_exists:
+            if not self.is_exist(fs_src_path):
+                raise FSFileNotExistsError(f"{fs_src_path} is not exists")
+            if self.is_exist(fs_dst_path):
+                raise FSFileExistsError(f"{fs_dst_path} exists already")
         self._run("-mv", fs_src_path, fs_dst_path)
 
     def upload(self, local_path, fs_path):

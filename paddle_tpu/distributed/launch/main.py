@@ -4,9 +4,18 @@ from __future__ import annotations
 import argparse
 import os
 import signal
+import socket
 import subprocess
 import sys
 import time
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
 
 
 def _parse():
@@ -71,6 +80,13 @@ def _spawn(args, attempt):
 
 def main():
     args = _parse()
+    if args.master is None and args.nnodes == 1:
+        # single-host default: an OS-assigned ephemeral port, so
+        # concurrent jobs on one machine (e.g. parallel test runs)
+        # don't all contend for one fixed port. A small race window
+        # remains between releasing the probe socket and the rank-0
+        # coordinator binding it.
+        args.master = f"127.0.0.1:{_free_port()}"
     attempt = 0
     procs = _spawn(args, attempt)
     code = 0

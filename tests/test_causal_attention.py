@@ -1,7 +1,8 @@
 """Causal-skip monolithic kernel (ops/pallas/causal_attention.py)
-numerics in interpret mode. The kernel is correct but measured slower
-e2e than simple_attention at S=1024 on v5e (see its docstring) — it is
-an available op, not in the flash dispatch chain."""
+numerics in interpret mode. Slower than simple_attention at S=1024 on
+v5e but ~1.8x faster than the q-block kernel at S=2048, so
+flash_attention_maybe dispatches simple -> causal-skip -> q-block ->
+library flash (see ops/pallas/flash_attention.py)."""
 import math
 
 import numpy as np

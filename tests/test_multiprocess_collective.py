@@ -15,6 +15,8 @@ import subprocess
 import sys
 
 WORKER = r'''
+import os
+
 from paddle_tpu._testing import force_cpu
 force_cpu()
 import jax
