@@ -75,13 +75,25 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False,
          no_grad_vars=None):
     """paddle.grad: partial-graph gradient (reference GeneralGrad,
-    eager/general_grad.h) — returns grads without mutating .grad."""
+    eager/general_grad.h) — returns grads without mutating .grad.
+
+    With create_graph=True the gradient computation itself is recorded
+    on the tape, so repeated grad() calls give true higher-order eager
+    derivatives — the capability the reference implements with its 105
+    hand-written *_double_grad ops (phi/ops/yaml/backward.yaml:4). The
+    TPU-native mechanism: the recorded subgraph from `outputs` down to
+    `inputs` is replayed as a pure jax function and its vjp is executed
+    as ONE new tape op, whose own jax.vjp supplies the next order.
+    """
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
         grad_outputs = [grad_outputs]
     if retain_graph is None:
         retain_graph = create_graph
+    if create_graph:
+        return _grad_create_graph(list(outputs), list(inputs),
+                                  grad_outputs, allow_unused)
     captured = run_backward(list(outputs), grad_outputs,
                             retain_graph=retain_graph, targets=list(inputs),
                             accumulate_leaf=False)
@@ -95,7 +107,204 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                     "allow_unused=True to get None instead")
             result.append(None)
         else:
-            result.append(Tensor._wrap(g, stop_gradient=not create_graph))
+            # create_graph=True returned earlier via _grad_create_graph
+            result.append(Tensor._wrap(g, stop_gradient=True))
+    return result
+
+
+# --------------------------------------------------------------------------
+# Higher-order eager grad: functional replay of the recorded subgraph
+# --------------------------------------------------------------------------
+def _replay_plan(outputs, inputs):
+    """Build the replay of the tape subgraph from `outputs` cut at
+    `inputs`.
+
+    Every differentiable source the subgraph touches becomes a slot:
+    the requested `inputs` (cut points) first, then every
+    differentiable leaf discovered while walking — so the recorded
+    gradient op stays connected to ALL upstream parameters (a second
+    backward must reach e.g. the discriminator weights in a gradient
+    penalty, not just the requested x).
+
+    Returns (F, slot_of, reps, used_slots): F maps one array per slot
+    to the tuple of output arrays; slot_of[i] is the slot of inputs[i]
+    (duplicates share one); reps is one representative Tensor per slot
+    (tape linkage for the composite op); used_slots are the requested
+    slots the outputs actually depend on through differentiable edges.
+    """
+    leaf_slot = {}       # id(leaf tensor) -> slot
+    nodeslot_slot = {}   # (id(node), out_idx) -> slot
+    slot_of = []
+    reps = []
+    for t in inputs:
+        key = ((id(t._grad_node), t._out_idx) if t._grad_node is not None
+               else id(t))
+        table = nodeslot_slot if t._grad_node is not None else leaf_slot
+        if key in table:
+            slot_of.append(table[key])
+        else:
+            table[key] = len(reps)
+            slot_of.append(len(reps))
+            reps.append(t)
+
+    def _not_replayable(node):
+        if node.vjp_fn is None:
+            return RuntimeError(
+                f"grad node {node.name} was already released; the first "
+                "backward must run with retain_graph=True (or be a "
+                "create_graph=True grad) to differentiate twice")
+        return NotImplementedError(
+            f"create_graph=True through op '{node.name}' is not "
+            "supported: the node has a custom python backward "
+            "(PyLayer) with no replayable forward. Express the custom "
+            "gradient with paddle_tpu ops, or use the functional "
+            "jacobian/hessian API")
+
+    # iterative post-order DFS over producer nodes, cut at input slots
+    order: list = []            # producers before consumers
+    used_slots = set()
+    visited = set()
+    stack = []
+
+    def _want(node):
+        if id(node) not in visited:
+            visited.add(id(node))
+            stack.append((node, False))
+
+    for t in outputs:
+        n = t._grad_node
+        if n is not None:
+            s = nodeslot_slot.get((id(n), t._out_idx))
+            if s is not None:
+                used_slots.add(s)
+            else:
+                _want(n)
+        else:
+            # output IS a requested leaf input: identity gradient
+            s = leaf_slot.get(id(t))
+            if s is not None:
+                used_slots.add(s)
+    while stack:
+        node, expanded = stack.pop()
+        if expanded:
+            order.append(node)
+            continue
+        if node.fwd_fn is None:
+            raise _not_replayable(node)
+        stack.append((node, True))
+        for e in node.edges:
+            if e is None:
+                continue
+            if e.leaf is not None:
+                s = leaf_slot.get(id(e.leaf))
+                if s is None:
+                    # newly discovered differentiable leaf: give it a
+                    # slot so the composite op links to it on the tape
+                    s = len(reps)
+                    leaf_slot[id(e.leaf)] = s
+                    reps.append(e.leaf)
+                used_slots.add(s)
+            else:
+                s = nodeslot_slot.get((id(e.node), e.out_idx))
+                if s is not None:
+                    used_slots.add(s)
+                else:
+                    _want(e.node)
+
+    def F(*xs):
+        def _sub(x, a):
+            # the op was recorded on post-AMP-cast arrays; replay must
+            # feed the same dtype (the cast is differentiable)
+            if x.dtype != a.dtype and jnp.issubdtype(a.dtype, jnp.inexact):
+                return x.astype(a.dtype)
+            return x
+
+        vals = {}
+        for node in order:
+            args = []
+            for e, a in zip(node.edges, node.in_arrays):
+                if e is None:
+                    args.append(a)
+                elif e.leaf is not None:
+                    s = leaf_slot.get(id(e.leaf))
+                    args.append(_sub(xs[s], a) if s is not None else a)
+                else:
+                    s = nodeslot_slot.get((id(e.node), e.out_idx))
+                    # interior values need the same recorded-dtype cast:
+                    # AMP casts BETWEEN ops (e.g. bf16 matmul feeding an
+                    # fp32 reduction)
+                    args.append(_sub(xs[s], a) if s is not None
+                                else _sub(vals[id(e.node)][e.out_idx], a))
+            out = node.fwd_fn(*args)
+            vals[id(node)] = ((out,) if not isinstance(out, (tuple, list))
+                              else tuple(out))
+        res = []
+        for t in outputs:
+            n = t._grad_node
+            if n is None:
+                s = leaf_slot.get(id(t))
+                res.append(xs[s] if s is not None else t._data)
+            else:
+                s = nodeslot_slot.get((id(n), t._out_idx))
+                res.append(xs[s] if s is not None
+                           else vals[id(n)][t._out_idx])
+        return tuple(res)
+
+    return F, slot_of, reps, used_slots
+
+
+def _grad_create_graph(outputs, inputs, grad_outputs, allow_unused):
+    from paddle_tpu.core.dispatch import run_op
+
+    for t in inputs:
+        if not isinstance(t, Tensor):
+            raise TypeError("grad inputs must be Tensors")
+    F, slot_of, reps, used_slots = _replay_plan(outputs, inputs)
+    n_slots = len(reps)
+    n_req = max(slot_of) + 1 if slot_of else 0   # requested slots prefix
+
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    cts = []
+    for t, go in zip(outputs, grad_outputs):
+        if go is None:
+            cts.append(jnp.ones(t.shape, t._data.dtype))
+        else:
+            cts.append(go)       # Tensor keeps its tape linkage
+
+    def gfun(*args):
+        xs, ct = args[:n_slots], args[n_slots:]
+        _, vjp = jax.vjp(F, *xs)
+        gs = vjp(tuple(ct))
+        # non-inexact primals come back as float0 — materialize zeros
+        # so the results wrap cleanly (they are filtered as unused)
+        return tuple(
+            jnp.zeros(x.shape, x.dtype)
+            if getattr(g, "dtype", None) == jax.dtypes.float0 else g
+            for g, x in zip(gs[:n_req], xs[:n_req]))
+
+    res = run_op("grad", gfun, *reps, *cts, amp=False)
+    res = (res,) if not isinstance(res, tuple) else res
+
+    result = []
+    for t, s in zip(inputs, slot_of):
+        if s not in used_slots:
+            if not allow_unused:
+                raise RuntimeError(
+                    "one of the input tensors received no gradient; pass "
+                    "allow_unused=True to get None instead")
+            result.append(None)
+        else:
+            g = res[s]
+            # the requested tensors' own grad hooks fire on the result
+            # (matches the tape walk); hooks on INTERIOR tensors do not
+            # run under create_graph=True — the replay is functional
+            for hook in t._grad_hooks:
+                out = hook(g)
+                if out is not None:
+                    g = out if isinstance(out, Tensor) else Tensor._wrap(
+                        out, stop_gradient=False)
+            result.append(g)
     return result
 
 

@@ -39,15 +39,22 @@ class GradNode:
     """Backward node for one eager op (cf. GradNodeBase, grad_node_info.h:197)."""
 
     __slots__ = ("name", "vjp_fn", "edges", "out_avals", "out_refs",
-                 "_buf", "_deps", "__weakref__")
+                 "fwd_fn", "in_arrays", "_buf", "_deps", "__weakref__")
 
     def __init__(self, name: str, vjp_fn, edges: List[Optional[Edge]],
-                 out_avals: List[Tuple[tuple, Any]]):
+                 out_avals: List[Tuple[tuple, Any]],
+                 fwd_fn=None, in_arrays=None):
         self.name = name
         self.vjp_fn = vjp_fn              # cotangents -> grads for all primals
         self.edges = edges                # one entry per primal; None = no grad
         self.out_avals = out_avals        # [(shape, dtype)] per forward output
         self.out_refs: List[Optional[weakref.ref]] = [None] * len(out_avals)
+        # replay captures for higher-order grad (create_graph=True):
+        # the forward jax function + its recorded (post-AMP) primal
+        # values — the reference's TensorWrapper captures feeding the
+        # *_double_grad ops (backward.yaml:4); released with vjp_fn
+        self.fwd_fn = fwd_fn
+        self.in_arrays = in_arrays
         self._buf = None                  # GradTensorHolder: per-output cotangent
         self._deps = 0
 
@@ -206,6 +213,8 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
         node._buf = None
         if not retain_graph:
             node.vjp_fn = None
+            node.fwd_fn = None
+            node.in_arrays = None
         for e, g in zip(node.edges, in_grads):
             if e is None or _is_float0(g):
                 continue

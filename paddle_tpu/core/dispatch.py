@@ -127,7 +127,8 @@ def run_op(name: str, fn: Callable, *inputs, n_outputs=None, amp=True,
             # as f's output (bare array, not 1-tuple)
             inner_vjp = vjp_fn
             vjp_fn = lambda cts: inner_vjp(cts[0])  # noqa: E731
-        node = GradNode(name, vjp_fn, edges, avals)
+        node = GradNode(name, vjp_fn, edges, avals,
+                        fwd_fn=fn, in_arrays=tuple(arrays))
         import weakref
         for i, ot in enumerate(out_tensors):
             if not ot.stop_gradient:
