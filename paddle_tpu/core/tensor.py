@@ -22,6 +22,18 @@ import jax.numpy as jnp
 from . import dtype as dtype_mod
 from .place import Place, _current_place, _parse_place
 
+# Scalarization interceptor (the SOT guard-capture seam, installed by
+# paddle_tpu.jit): fn(kind, array) -> (handled, python_value). Active
+# only while a to_static probe/replay context is open; None otherwise.
+_scalarize_interceptor = None
+
+
+def set_scalarize_interceptor(fn):
+    global _scalarize_interceptor
+    prev = _scalarize_interceptor
+    _scalarize_interceptor = fn
+    return prev
+
 
 class Tensor:
     __slots__ = ("_data", "stop_gradient", "grad", "_grad_node", "_out_idx",
@@ -137,6 +149,10 @@ class Tensor:
         return a.astype(dtype) if dtype is not None else a
 
     def item(self, *args):
+        if not args and _scalarize_interceptor is not None:
+            handled, val = _scalarize_interceptor("item", self._data)
+            if handled:
+                return val
         arr = np.asarray(self._data)
         return arr.item(*args)
 
@@ -263,15 +279,31 @@ class Tensor:
                 f"stop_gradient={sg},\n       {body})")
 
     def __bool__(self):
+        if _scalarize_interceptor is not None:
+            handled, val = _scalarize_interceptor("bool", self._data)
+            if handled:
+                return val
         return bool(np.asarray(self._data))
 
     def __int__(self):
+        if _scalarize_interceptor is not None:
+            handled, val = _scalarize_interceptor("int", self._data)
+            if handled:
+                return val
         return int(np.asarray(self._data))
 
     def __float__(self):
+        if _scalarize_interceptor is not None:
+            handled, val = _scalarize_interceptor("float", self._data)
+            if handled:
+                return val
         return float(np.asarray(self._data))
 
     def __index__(self):
+        if _scalarize_interceptor is not None:
+            handled, val = _scalarize_interceptor("int", self._data)
+            if handled:
+                return val
         return int(np.asarray(self._data))
 
     def __iter__(self):

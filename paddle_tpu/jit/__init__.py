@@ -108,14 +108,134 @@ def _flatten_out(out):
     return arrays, (treedef, spec)
 
 
+# --------------------------------------------------------------------------
+# SOT-equivalent guarded specialization (reference: paddle.jit.sot
+# opcode_translator guards + graph breaks, sot/opcode_translator/executor/
+# opcode_executor.py:1603 — redesigned without bytecode simulation).
+#
+# Mechanism: python control flow on tensor VALUES surfaces as a
+# scalarization (`bool(t)` / `int(t)` / `float(t)` / `t.item()`). The
+# Tensor layer routes those through an interceptor:
+#   * probe mode (eager): record each (kind, concrete value) — the
+#     "decision trace" = the guard set of one specialization.
+#   * replay mode (under jit trace): answer each query from the recorded
+#     decisions (concretizing the branch) and emit the queried value as
+#     an extra compiled output (the guard predicate).
+# Each specialization = (decisions, executable). A call runs the
+# most-recently-used specialization and validates the returned predicate
+# values against its decisions; on mismatch it de-optimizes: state is
+# untouched (decision specs never donate buffers), the call re-runs as
+# an eager probe, and the new decision trace selects or compiles another
+# specialization. Functions with no tensor-value branching keep the old
+# single-executable fast path (empty decision trace, donation on).
+# --------------------------------------------------------------------------
+class GraphBreak(Exception):
+    """Python control flow consumed a traced tensor value (query #idx)."""
+
+    def __init__(self, kind, index):
+        self.kind = kind
+        self.index = index
+        super().__init__(
+            f"graph break: python {kind}() on a traced tensor "
+            f"(scalarization query #{index})")
+
+
+class _ProbeCtx:
+    __slots__ = ("decisions",)
+
+    def __init__(self):
+        self.decisions = []
+
+
+class _ReplayCtx:
+    __slots__ = ("decisions", "idx", "preds")
+
+    def __init__(self, decisions):
+        self.decisions = decisions
+        self.idx = 0
+        self.preds = []
+
+
+_ctx_stack: List[Any] = []
+
+_CONCRETIZE = {
+    "bool": lambda a: bool(np.asarray(a)),
+    "int": lambda a: int(np.asarray(a)),
+    "float": lambda a: float(np.asarray(a)),
+    "item": lambda a: np.asarray(a).item(),
+}
+
+
+def _scalarize_interceptor(kind, array):
+    if not _ctx_stack:
+        return False, None
+    ctx = _ctx_stack[-1]
+    if isinstance(ctx, _ProbeCtx):
+        val = _CONCRETIZE[kind](array)
+        ctx.decisions.append((kind, val))
+        return True, val
+    i = ctx.idx
+    if i >= len(ctx.decisions) or ctx.decisions[i][0] != kind:
+        raise GraphBreak(kind, i)
+    ctx.idx += 1
+    ctx.preds.append(jnp.asarray(array))
+    return True, ctx.decisions[i][1]
+
+
+from paddle_tpu.core import tensor as _tensor_mod  # noqa: E402
+
+_tensor_mod.set_scalarize_interceptor(_scalarize_interceptor)
+
+#: cap on cached specializations per input signature; beyond it the
+#: signature falls back to eager (decision traces that differ on every
+#: call would otherwise retrace forever)
+MAX_SPECIALIZATIONS = 8
+
+#: weak registry of StaticFunctions for the module-level report API
+import weakref  # noqa: E402
+
+_static_functions: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _consistent(decisions, observed):
+    """True when a spec's decisions agree with an observed (kind, value)
+    prefix from another spec's run — same queries up to the shorter."""
+    return all(d == o for d, o in zip(decisions, observed))
+
+
+class _Spec:
+    __slots__ = ("decisions", "jitted", "out_spec", "hits")
+
+    def __init__(self, decisions):
+        self.decisions = decisions
+        self.jitted = None          # set by StaticFunction._build
+        self.out_spec = None        # set by this spec's own trace
+        self.hits = 0
+
+
+def _float_thrash(new, old):
+    """True when two decision traces differ ONLY in float-valued
+    float()/item() guards — the raw value of a logged loss, never
+    stable call-to-call. Compiling one specialization per observed
+    float would burn a full XLA compile every step."""
+    if len(new) != len(old):
+        return False
+    diff = [(a, b) for a, b in zip(new, old) if a != b]
+    return bool(diff) and all(
+        a[0] == b[0] and a[0] in ("float", "item")
+        and isinstance(a[1], float) and isinstance(b[1], float)
+        for a, b in diff)
+
+
 class StaticFunction:
     def __init__(self, fn, objs=None, donate_states=True, backend=None):
         self._fn = fn
         self._objs = objs
         self._donate = donate_states
-        self._cache = {}
+        self._cache = {}          # signature -> entry dict
         self._state: Optional[List[Tensor]] = None
         functools.update_wrapper(self, fn, updated=[])
+        _static_functions.add(self)
 
     def _resolve_state(self):
         objs = self._objs
@@ -126,49 +246,179 @@ class StaticFunction:
         layers, opts, scalers = _collect_objects(objs)
         return _state_tensors(layers, opts, scalers)
 
+    # -- report API (reference: sot graph-break / guard introspection) --
+    def specializations(self):
+        """Per input signature: the list of decision traces compiled."""
+        return {sig: [s.decisions for s in e["specs"]]
+                for sig, e in self._cache.items()}
+
+    def report(self):
+        out = []
+        for sig, e in self._cache.items():
+            out.append({
+                "signature": repr(sig),
+                "specializations": [
+                    {"decisions": s.decisions, "hits": s.hits}
+                    for s in e["specs"]],
+                "graph_breaks": e["breaks"],
+                "eager_probes": e["probes"],
+                "fallback": e["fallback"],
+            })
+        return {"function": getattr(self._fn, "__qualname__", str(self._fn)),
+                "signatures": out}
+
     def __call__(self, *args, **kwargs):
-        if getattr(self, "_fallback_eager", False):
-            return self._fn(*args, **kwargs)
         state = self._resolve_state()
         gen = gen_mod.default_generator()
         arg_arrays, meta = _tree_flatten_args(args, kwargs)
-        key = (meta[0], tuple(
+        if _ctx_stack or any(
+                isinstance(a, jax.core.Tracer)
+                for a in arg_arrays) or any(
+                isinstance(t._data, jax.core.Tracer) for t in state):
+            # already inside a to_static probe/replay or a raw jax
+            # trace: inline into the enclosing program (the outer
+            # context owns the scalarization decisions)
+            return self._fn(*args, **kwargs)
+        sig = (meta[0], tuple(
             s if s[0] == "S" and _hashable(s) else ("T",)
             for s in meta[1]), len(state))
+        entry = self._cache.get(sig)
+        if entry is None:
+            entry = self._cache[sig] = {
+                "specs": [], "mru": 0, "breaks": 0, "probes": 0,
+                "fallback": None}
+        if entry["fallback"] is not None:
+            return self._fn(*args, **kwargs)
 
-        if key not in self._cache:
-            self._cache[key] = [self._build(state, meta), None]
-        jitted, out_spec = self._cache[key]
+        if not entry["specs"]:
+            # optimistic first specialization: no decisions
+            spec0 = _Spec(())
+            self._build(spec0, meta, donate=self._donate)
+            entry["specs"].append(spec0)
+            entry["mru"] = 0
+        tried = set()
+        idx = entry["mru"]
+        while True:
+            spec = entry["specs"][idx]
+            tried.add(idx)
+            try:
+                ok, result, observed = self._run_spec(
+                    spec, state, gen, arg_arrays)
+            except GraphBreak:
+                entry["breaks"] += 1
+                if not spec.decisions:
+                    entry["specs"].pop(idx)        # invalid skeleton
+                    entry["mru"] = 0
+                return self._probe(entry, meta, args, kwargs)
+            except (jax.errors.TracerBoolConversionError,
+                    jax.errors.ConcretizationTypeError,
+                    jax.errors.TracerArrayConversionError) as e:
+                # untraceable beyond the Tensor seam (e.g. numpy() on a
+                # traced value): this signature stays eager
+                import warnings
+                warnings.warn(
+                    f"to_static: {self._fn.__qualname__} is not "
+                    f"traceable ({type(e).__name__}); falling back to "
+                    f"eager execution", stacklevel=2)
+                entry["fallback"] = f"{type(e).__name__}: {e}"
+                return self._fn(*args, **kwargs)
+            if ok:
+                spec.hits += 1
+                entry["mru"] = idx
+                return result
+            # guard mismatch: another cached specialization whose
+            # decisions agree with the observed predicate values can
+            # serve this call compiled (alternating branches stay off
+            # the eager path); it re-validates its own guards anyway
+            nxt = None
+            for i, s in enumerate(entry["specs"]):
+                if i not in tried and _consistent(s.decisions, observed):
+                    nxt = i
+                    break
+            if nxt is None:
+                entry["breaks"] += 1
+                return self._probe(entry, meta, args, kwargs)
+            idx = nxt
 
+    def _run_spec(self, spec, state, gen, arg_arrays):
+        """Returns (guards_ok, result, observed decision values);
+        state committed only when guards pass."""
         state_arrays = [t._data for t in state]
         key_in = gen._base_key()
-        try:
-            out_arrays, new_state, new_key = jitted(
-                state_arrays, key_in, arg_arrays)
-        except (jax.errors.TracerBoolConversionError,
-                jax.errors.ConcretizationTypeError,
-                jax.errors.TracerArrayConversionError) as e:
-            # graph break (reference SOT: untraceable python control
-            # flow falls back to eager; here at function granularity)
-            import warnings
-            warnings.warn(
-                f"to_static: {self._fn.__qualname__} is not traceable "
-                f"({type(e).__name__}); falling back to eager "
-                f"execution", stacklevel=2)
-            self._fallback_eager = True
-            self._cache.pop(key, None)
-            return self._fn(*args, **kwargs)
+        out_arrays, new_state, new_key, preds = spec.jitted(
+            state_arrays, key_in, arg_arrays)
+        if spec.decisions:
+            # one batched device->host transfer for all guards
+            host = jax.device_get(list(preds))
+            observed = [(kind, _CONCRETIZE[kind](h))
+                        for h, (kind, _) in zip(host, spec.decisions)]
+        else:
+            observed = []
+        if observed != list(spec.decisions):
+            return False, None, observed
         for t, a in zip(state, new_state):
             t._data = a
         gen._key = new_key
-        if out_spec is None:
-            out_spec = self._out_spec  # set by pure() during the trace
-            self._cache[key][1] = out_spec
-        return _unflatten_out(out_arrays, out_spec)
+        return True, _unflatten_out(out_arrays, spec.out_spec), observed
 
-    def _build(self, state_template, meta):
+    def _probe(self, entry, meta, args, kwargs):
+        """Eager probe: run the python function concretely, capturing
+        the decision trace; then select or compile the matching
+        specialization for future calls."""
+        entry["probes"] += 1
+        ctx = _ProbeCtx()
+        _ctx_stack.append(ctx)
+        try:
+            result = self._fn(*args, **kwargs)
+        finally:
+            _ctx_stack.pop()
+        decisions = tuple(ctx.decisions)
+        if not decisions:
+            # the break did not come through the Tensor seam — nothing
+            # to guard on; stay eager for this signature
+            entry["fallback"] = "graph break outside the Tensor seam"
+            return result
+        for i, s in enumerate(entry["specs"]):
+            if s.decisions == decisions:
+                entry["mru"] = i
+                return result
+        n_float_twins = sum(_float_thrash(decisions, s.decisions)
+                            for s in entry["specs"])
+        if n_float_twins >= 2:
+            # raw float guards that never repeat (logged loss values):
+            # compiling one spec per observed float burns a full XLA
+            # compile every call. Two exact float values may legitimately
+            # alternate (a threshold test on a bimodal input); at the
+            # third distinct value, stay eager for this signature.
+            import warnings
+            warnings.warn(
+                f"to_static: {self._fn.__qualname__} consumes a "
+                "volatile float tensor value in python "
+                "(float()/item()); guards on it never repeat, so this "
+                "signature stays eager", stacklevel=3)
+            entry["fallback"] = "volatile float guard"
+            return result
+        if len(entry["specs"]) >= MAX_SPECIALIZATIONS:
+            import warnings
+            warnings.warn(
+                f"to_static: {self._fn.__qualname__} exceeded "
+                f"{MAX_SPECIALIZATIONS} specializations for one input "
+                "signature (value-dependent control flow thrashes); "
+                "falling back to eager execution", stacklevel=3)
+            entry["fallback"] = "specialization limit exceeded"
+            return result
+        # decision specializations never donate: a later guard mismatch
+        # must leave the caller's state buffers intact for the re-probe
+        spec = _Spec(decisions)
+        self._build(spec, meta, donate=False)
+        entry["specs"].append(spec)
+        entry["mru"] = len(entry["specs"]) - 1
+        return result
+
+    def _build(self, spec, meta, donate):
         fn = self._fn
         outer = self
+        decisions = spec.decisions
 
         def pure(state_arrays, rng_key, arg_arrays):
             state = outer._resolve_state()
@@ -177,6 +427,8 @@ class StaticFunction:
                            for t in state]
             gen = gen_mod.default_generator()
             saved_key, saved_off = gen._key, gen._offset
+            ctx = _ReplayCtx(decisions)
+            _ctx_stack.append(ctx)
             try:
                 for t, a in zip(state, state_arrays):
                     t._data = a
@@ -187,11 +439,15 @@ class StaticFunction:
                 args, kwargs = _tree_unflatten_args(arg_arrays, meta)
                 out = fn(*args, **kwargs)
                 out_arrays, out_spec = _flatten_out(out)
-                outer._out_spec = out_spec
+                # each spec owns its out_spec (branches may return
+                # different pytree structures)
+                spec.out_spec = out_spec
                 new_state = [t._data for t in state]
                 new_key = jax.random.fold_in(rng_key, gen._offset + 1)
-                return out_arrays, new_state, new_key
+                return (out_arrays, new_state, new_key,
+                        tuple(ctx.preds))
             finally:
+                _ctx_stack.pop()
                 for t, s, (n, i, g) in zip(state, saved, saved_nodes):
                     t._data = s
                     t._grad_node = n
@@ -199,8 +455,14 @@ class StaticFunction:
                     t.grad = g
                 gen._key, gen._offset = saved_key, saved_off
 
-        donate = (0,) if self._donate else ()
-        return jax.jit(pure, donate_argnums=donate)
+        spec.jitted = jax.jit(pure, donate_argnums=(0,) if donate else ())
+        return spec
+
+
+def sot_report():
+    """Graph-break / specialization report across every to_static
+    function (reference: paddle.jit.sot introspection utilities)."""
+    return [sf.report() for sf in _static_functions]
 
 
 def _hashable(s):

@@ -1,0 +1,236 @@
+"""Runtime attention-kernel autotune: measure-and-cache dispatch.
+
+Reference being re-designed: phi/kernels/autotune/{auto_tune_base.h,
+cache.cc,switch_autotune.cc} — run each candidate kernel once with a
+GPU timer, cache the winner keyed by shape, re-use thereafter.
+
+TPU-native version: the candidates are the three in-tree Pallas
+attention kernels plus the jax library flash kernel plus plain XLA
+attention. A measurement times fwd+bwd (the kernels live inside
+training steps) under jit with a scalar readback sync (the tunneled
+PJRT backend acks block_until_ready early — NOTES.md). Winners are
+cached per (device_kind, B, H, S, Skv, D, dtype, causal) in memory and
+persisted as JSON so later processes on the same device kind skip the
+measurement. Under tracing (shapes are tracers at dispatch time inside
+jit) the table answers; with no entry the static chain measured on
+v5e (flash_attention.flash_attention_maybe docstring) decides, so
+cold-trace behavior is exactly the hand-tuned round-1 dispatch.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.flags import define_flag, get_flag
+
+define_flag("FLAGS_attn_autotune", True,
+            "measure-and-cache attention kernel choice on the first "
+            "eager call per shape (trace-time dispatch only consults "
+            "the cached table)")
+
+#: candidate name -> runner(q, k, v, causal, scale) in [B,S,H,D] layout;
+#: populated lazily to keep kernel imports off the module-import path
+_RUNNERS = None
+
+_table: Optional[Dict[str, dict]] = None
+
+
+def _cache_path() -> str:
+    base = os.environ.get("PADDLE_TPU_CACHE_DIR")
+    if base is None:
+        base = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), ".cache")
+    return os.path.join(base, "attn_autotune.json")
+
+
+def _load_table() -> Dict[str, dict]:
+    global _table
+    if _table is None:
+        _table = {}
+        try:
+            with open(_cache_path()) as f:
+                _table = json.load(f)
+        except (OSError, ValueError):
+            pass
+    return _table
+
+
+def _save_table() -> None:
+    path = _cache_path()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(_table, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)       # atomic: concurrent writers cannot
+        # interleave into corrupt JSON (last writer wins whole-file)
+    except OSError:
+        pass                        # read-only FS: in-memory cache only
+
+
+def _device_kind() -> str:
+    try:
+        return jax.devices()[0].device_kind.replace(" ", "_")
+    except Exception:
+        return "unknown"
+
+
+def _key(bshd: Tuple[int, int, int, int], skv: int, dtype,
+         causal: bool) -> str:
+    b, s, h, d = bshd
+    return (f"{_device_kind()}|B{b}S{s}H{h}D{d}Skv{skv}|"
+            f"{jnp.dtype(dtype).name}|causal={bool(causal)}")
+
+
+def _runners():
+    global _RUNNERS
+    if _RUNNERS is not None:
+        return _RUNNERS
+    from paddle_tpu.ops.pallas import causal_attention as cak
+    from paddle_tpu.ops.pallas import simple_attention as sa
+    from paddle_tpu.ops.pallas import simple_attention2 as sa2
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    def _bhsd(run):
+        def wrapped(q, k, v, causal, scale):
+            qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+            return jnp.swapaxes(run(qt, kt, vt, causal, scale), 1, 2)
+        return wrapped
+
+    def _xla(q, k, v, causal, scale):
+        d = q.shape[-1]
+        sm = scale if scale is not None else 1.0 / math.sqrt(d)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * sm
+        if causal:
+            sq, sk = q.shape[1], k.shape[1]
+            mask = jnp.tril(jnp.ones((sq, sk), bool), sk - sq)
+            logits = jnp.where(mask, logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+    _RUNNERS = {
+        "simple": _bhsd(lambda q, k, v, c, s: sa.attention_bhsd(
+            q, k, v, causal=c, scale=s)),
+        "causal_skip": _bhsd(lambda q, k, v, c, s: cak.attention_bhsd(
+            q, k, v, causal=c, scale=s)),
+        "qblock": _bhsd(lambda q, k, v, c, s: sa2.attention_bhsd(
+            q, k, v, causal=c, scale=s)),
+        "library_flash": fa.flash_attention,
+        "xla": _xla,
+    }
+    return _RUNNERS
+
+
+def candidates(bshd, skv, dtype, causal) -> List[str]:
+    """Kernels whose shape gates accept this problem ([B,S,H,D])."""
+    from paddle_tpu.ops.pallas import causal_attention as cak
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    from paddle_tpu.ops.pallas import simple_attention as sa
+    from paddle_tpu.ops.pallas import simple_attention2 as sa2
+    b, s, h, d = bshd
+    bhsd = (b, h, s, d)
+    out = []
+    if s == skv:
+        if sa.supported(bhsd, dtype):
+            out.append("simple")
+        if causal and cak.supported(bhsd, dtype):
+            out.append("causal_skip")
+        if sa2.supported(bhsd, dtype):
+            out.append("qblock")
+    if fa.supported_shape(bshd, skv, dtype):
+        out.append("library_flash")
+    out.append("xla")
+    return out
+
+
+def _time_candidate(name: str, q, k, v, causal, scale,
+                    reps: int = 3) -> float:
+    """fwd+bwd wall time per rep; inf when the kernel fails."""
+    run = _runners()[name]
+
+    def fb(q, k, v):
+        out, vjp = jax.vjp(lambda a, b, c: run(a, b, c, causal, scale),
+                           q, k, v)
+        return vjp(jnp.ones_like(out))
+
+    fb = jax.jit(fb)
+    try:
+        r = fb(q, k, v)
+        float(jnp.sum(r[0]))        # sync (tunnel-safe scalar readback)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            r = fb(q, k, v)
+        float(jnp.sum(r[0]))
+        return (time.perf_counter() - t0) / reps
+    except Exception:
+        return float("inf")
+
+
+def measure(bshd, skv, dtype, causal, scale=None) -> str:
+    """Benchmark all shape-feasible candidates on random data, record
+    the winner in the (persisted) table, return its name."""
+    tab = _load_table()
+    key = _key(bshd, skv, dtype, causal)
+    if key in tab:
+        return tab[key]["winner"]
+    b, s, h, d = bshd
+    rng = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (b, s, h, d), dtype)
+    k = jax.random.normal(kk, (b, skv, h, d), dtype)
+    v = jax.random.normal(kv, (b, skv, h, d), dtype)
+    timings = {}
+    for name in candidates(bshd, skv, dtype, causal):
+        timings[name] = _time_candidate(name, q, k, v, causal, scale)
+    winner = min(timings, key=timings.get)
+    tab[key] = {"winner": winner,
+                "timings_ms": {n: (None if not np.isfinite(t)
+                                   else round(t * 1e3, 4))
+                               for n, t in timings.items()}}
+    _save_table()
+    return winner
+
+
+def lookup(bshd, skv, dtype, causal) -> Optional[str]:
+    ent = _load_table().get(_key(bshd, skv, dtype, causal))
+    return None if ent is None else ent["winner"]
+
+
+def decide(q, k, causal) -> Optional[str]:
+    """Dispatch decision for concrete or traced q/k ([B,S,H,D]).
+
+    Concrete arrays with autotune enabled: measure (once) and answer
+    from the table. Traced: table lookup only. None means "use the
+    static chain" — also the escape hatch: disabling the flag bypasses
+    the table entirely, restoring the hand-tuned chain.
+    """
+    if not get_flag("FLAGS_attn_autotune"):
+        return None
+    bshd = tuple(q.shape)
+    skv = k.shape[1]
+    hit = lookup(bshd, skv, q.dtype, causal)
+    if hit is not None:
+        return hit
+    if isinstance(q, jax.core.Tracer):
+        return None
+    if jax.default_backend() != "tpu":
+        return None                 # measuring CPU pallas is meaningless
+    try:
+        if jax.process_count() > 1:
+            # multi-process SPMD: per-rank measurement could pick
+            # different kernels per rank; keep the deterministic chain
+            return None
+    except Exception:
+        pass
+    return measure(bshd, skv, q.dtype, causal)
+
+
+def run(name: str, q, k, v, causal, scale):
+    return _runners()[name](q, k, v, causal, scale)

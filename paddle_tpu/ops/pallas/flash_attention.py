@@ -16,15 +16,17 @@ import jax
 import jax.numpy as jnp
 
 
+def supported_shape(bshd, skv, dtype) -> bool:
+    """Library-flash shape gate ([B,S,H,D] + kv length); the single
+    home for this predicate (autotune.candidates uses it too)."""
+    if dtype not in (jnp.float32, jnp.bfloat16):
+        return False
+    b, s, h, d = bshd
+    return s % 128 == 0 and skv % 128 == 0 and d % 64 == 0
+
+
 def _supported(q, k, v):
-    if q.dtype not in (jnp.float32, jnp.bfloat16):
-        return False
-    b, s, h, d = q.shape
-    if s % 128 != 0 or k.shape[1] % 128 != 0:
-        return False
-    if d % 64 != 0:
-        return False
-    return True
+    return supported_shape(tuple(q.shape), k.shape[1], q.dtype)
 
 
 def flash_attention(q, k, v, causal: bool = False,
@@ -67,9 +69,17 @@ def flash_attention_maybe(q, k, v, causal=False, scale=None):
             return None
         if not _supported(q, k, v):
             return None
+        from paddle_tpu.ops.pallas import autotune
         from paddle_tpu.ops.pallas import causal_attention as cak
         from paddle_tpu.ops.pallas import simple_attention as sa
         from paddle_tpu.ops.pallas import simple_attention2 as sa2
+        # measured winner (runtime autotune cache / first-call timing)
+        # takes precedence over the static chain below
+        tuned = autotune.decide(q, k, causal)
+        if tuned is not None:
+            if tuned == "xla":
+                return None
+            return autotune.run(tuned, q, k, v, causal, scale)
         # Dispatch order (v5e measurements): at S<=1024 the full-S^2
         # monolithic kernel wins (VPU-bound; causal skipping does not
         # pay: 49.1k vs 50.6k tok/s e2e). Where the whole [S,S] score

@@ -1,0 +1,249 @@
+"""SOT-equivalent guarded multi-specialization JIT (jit/__init__.py).
+
+Reference: paddle.jit.sot builds guarded partial graphs via bytecode
+simulation (sot/opcode_translator/executor/opcode_executor.py:1603);
+on a guard failure it re-specializes instead of staying eager.
+
+TPU-native redesign under test: python control flow on tensor values
+surfaces as Tensor scalarization; a probe/replay interceptor turns
+each scalarization outcome into a guard, every guard set becomes one
+compiled specialization, and the compiled program re-emits the guard
+predicates so each call validates its specialization and de-optimizes
+through an eager probe on mismatch.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import (MAX_SPECIALIZATIONS, StaticFunction,
+                            sot_report, to_static)
+
+
+def _arr(v):
+    return paddle.to_tensor(np.asarray(v, np.float32))
+
+
+def test_value_dependent_branch_two_specializations():
+    calls = []
+
+    @to_static
+    def f(x):
+        calls.append(1)
+        if (x.mean() > 0):          # python branch on a tensor value
+            return x * 2.0
+        return x - 1.0
+
+    pos = _arr([1.0, 2.0])
+    neg = _arr([-1.0, -2.0])
+    # 1st positive call: skeleton breaks -> eager probe + spec A
+    np.testing.assert_allclose(np.asarray(f(pos)._data), [2.0, 4.0])
+    # 2nd positive call: compiled spec A (guards pass)
+    np.testing.assert_allclose(np.asarray(f(pos)._data), [2.0, 4.0])
+    # negative: guard mismatch -> probe + spec B
+    np.testing.assert_allclose(np.asarray(f(neg)._data), [-2.0, -3.0])
+    # both paths now compiled; alternate freely
+    np.testing.assert_allclose(np.asarray(f(neg)._data), [-2.0, -3.0])
+    np.testing.assert_allclose(np.asarray(f(pos)._data), [2.0, 4.0])
+
+    specs = list(f.specializations().values())[0]
+    assert len(specs) == 2, specs
+    assert ("bool", True) in [d for ds in specs for d in ds]
+    rep = f.report()["signatures"][0]
+    assert rep["fallback"] is None
+    assert rep["graph_breaks"] >= 2
+    # compiled hits: calls 2, 4, 5 ran the executable, probes only 1, 3
+    assert sum(s["hits"] for s in rep["specializations"]) == 3
+    assert len(calls) > 0
+
+
+def test_no_branching_single_spec_no_probe():
+    @to_static
+    def f(x):
+        return x * 3.0
+
+    f(_arr([1.0]))
+    f(_arr([2.0]))
+    rep = f.report()["signatures"][0]
+    assert len(rep["specializations"]) == 1
+    assert rep["specializations"][0]["decisions"] == ()
+    assert rep["eager_probes"] == 0
+    assert rep["graph_breaks"] == 0
+
+
+def test_int_specialization_guard():
+    @to_static
+    def f(x):
+        k = int(x.sum()) % 2        # python int of a tensor value
+        if k == 0:
+            return x + 10.0
+        return x - 10.0
+
+    even = _arr([2.0, 2.0])
+    odd = _arr([2.0, 3.0])
+    np.testing.assert_allclose(np.asarray(f(even)._data), [12.0, 12.0])
+    np.testing.assert_allclose(np.asarray(f(odd)._data), [-8.0, -7.0])
+    np.testing.assert_allclose(np.asarray(f(even)._data), [12.0, 12.0])
+    specs = list(f.specializations().values())[0]
+    assert len(specs) == 2
+    kinds = {d[0] for ds in specs for d in ds}
+    assert "int" in kinds
+
+
+def test_item_and_float_guards():
+    @to_static
+    def f(x):
+        if x.max().item() > 5.0:
+            return x / 2.0
+        return x
+
+    np.testing.assert_allclose(np.asarray(f(_arr([8.0]))._data), [4.0])
+    np.testing.assert_allclose(np.asarray(f(_arr([1.0]))._data), [1.0])
+    np.testing.assert_allclose(np.asarray(f(_arr([8.0]))._data), [4.0])
+    assert len(list(f.specializations().values())[0]) == 2
+
+
+def test_volatile_float_guard_falls_back_fast():
+    """float(loss)-style guards never repeat; after the second distinct
+    value the signature goes eager instead of burning one XLA compile
+    per call."""
+    @to_static
+    def f(x):
+        return x + float(x.sum())   # a new float guard every call
+
+    f(_arr([1.0]))                  # probe + spec for value 1.0
+    f(_arr([2.0]))                  # second distinct float: one more
+    with pytest.warns(UserWarning, match="volatile float"):
+        f(_arr([3.0]))              # third distinct value: go eager
+    rep = f.report()["signatures"][0]
+    assert rep["fallback"] == "volatile float guard"
+    assert len(rep["specializations"]) <= 3
+    np.testing.assert_allclose(np.asarray(f(_arr([50.0]))._data), [100.0])
+
+
+def test_specialization_limit_falls_back():
+    @to_static
+    def f(x):
+        k = int(x.sum())            # a new int guard every call
+        return x + float(k)
+
+    for i in range(MAX_SPECIALIZATIONS + 2):
+        v = float(i)
+        with pytest.warns(UserWarning) if i == MAX_SPECIALIZATIONS \
+                else _nullcontext():
+            out = f(_arr([v]))
+        np.testing.assert_allclose(np.asarray(out._data), [2 * v])
+    rep = f.report()["signatures"][0]
+    assert rep["fallback"] == "specialization limit exceeded"
+    # still correct after fallback
+    np.testing.assert_allclose(np.asarray(f(_arr([50.0]))._data), [100.0])
+
+
+def test_branches_with_different_pytree_structures():
+    """Each specialization owns its out_spec: branches may return
+    different structures."""
+    @to_static
+    def f(x):
+        if (x.mean() > 0):
+            return x * 2.0
+        return (x - 1.0, x.sum())
+
+    pos, neg = _arr([1.0]), _arr([-1.0])
+    np.testing.assert_allclose(np.asarray(f(pos)._data), [2.0])
+    np.testing.assert_allclose(np.asarray(f(pos)._data), [2.0])
+    out = f(neg)
+    assert isinstance(out, tuple) and len(out) == 2
+    out = f(neg)                    # compiled tuple-branch
+    assert isinstance(out, tuple) and len(out) == 2
+    # alternate back: compiled single-tensor branch, right structure
+    np.testing.assert_allclose(np.asarray(f(pos)._data), [2.0])
+    out = f(neg)
+    assert isinstance(out, tuple)
+    np.testing.assert_allclose(np.asarray(out[0]._data), [-2.0])
+
+
+def test_nested_static_function_inlines():
+    """A to_static function called inside another to_static trace
+    inlines into the outer program instead of going eager-fallback."""
+    @to_static
+    def inner(x):
+        if (x.mean() > 0):
+            return x * 3.0
+        return x
+
+    @to_static
+    def outer(x):
+        return inner(x) + 1.0
+
+    np.testing.assert_allclose(np.asarray(outer(_arr([2.0]))._data),
+                               [7.0])
+    np.testing.assert_allclose(np.asarray(outer(_arr([2.0]))._data),
+                               [7.0])
+    # inner keeps working standalone, still compiled
+    np.testing.assert_allclose(np.asarray(inner(_arr([2.0]))._data),
+                               [6.0])
+    assert inner.report()["signatures"][0]["fallback"] is None
+    assert outer.report()["signatures"][0]["fallback"] is None
+
+
+class _nullcontext:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def test_untraceable_numpy_falls_back_per_signature():
+    @to_static
+    def f(x):
+        return _arr(np.asarray(x.numpy()) * 2.0)
+
+    with pytest.warns(UserWarning, match="not traceable"):
+        out = f(_arr([3.0]))
+    np.testing.assert_allclose(np.asarray(out._data), [6.0])
+    out = f(_arr([4.0]))
+    np.testing.assert_allclose(np.asarray(out._data), [8.0])
+    assert f.report()["signatures"][0]["fallback"] is not None
+
+
+def test_train_step_with_loss_conditional_stays_compiled():
+    """A train step whose python logic branches on the loss value (a
+    hand-rolled skip-on-spike heuristic) keeps two compiled
+    specializations and still trains."""
+    import paddle_tpu.nn as nn
+
+    model = nn.Linear(4, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+
+    def step(xb, yb):
+        pred = model(xb)
+        loss = ((pred - yb) ** 2).mean()
+        if (loss < 100.0):          # value-dependent python branch
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return loss
+
+    sstep = to_static(step, objs=[model, opt])
+    rng = np.random.RandomState(0)
+    xb = _arr(rng.randn(8, 4))
+    yb = _arr(rng.randn(8, 1))
+    losses = [float(sstep(xb, yb)._data) for _ in range(5)]
+    assert losses[-1] < losses[0]
+    big = _arr(rng.randn(8, 1) * 1000.0)
+    sstep(xb, big)                  # takes the skip branch
+    specs = list(sstep.specializations().values())
+    flat = [d for sig in specs for d in sig]
+    assert len(flat) >= 2
+    assert sstep.report()["signatures"][0]["fallback"] is None
+
+
+def test_sot_report_module_level():
+    @to_static
+    def f(x):
+        return x + 1.0
+
+    f(_arr([1.0]))
+    reps = sot_report()
+    assert any(r["function"].endswith("f") for r in reps)
