@@ -156,7 +156,31 @@ class _ReplayCtx:
         self.preds = []
 
 
-_ctx_stack: List[Any] = []
+import threading  # noqa: E402
+
+
+class _CtxStack(threading.local):
+    """Per-thread probe/replay stack: a trace in one thread must not
+    hijack Tensor scalarizations happening on other threads (data
+    prefetch, logging)."""
+
+    def __init__(self):
+        self.items: List[Any] = []
+
+    def __bool__(self):
+        return bool(self.items)
+
+    def append(self, x):
+        self.items.append(x)
+
+    def pop(self):
+        return self.items.pop()
+
+    def __getitem__(self, i):
+        return self.items[i]
+
+
+_ctx_stack = _CtxStack()
 
 _CONCRETIZE = {
     "bool": lambda a: bool(np.asarray(a)),
@@ -164,6 +188,25 @@ _CONCRETIZE = {
     "float": lambda a: float(np.asarray(a)),
     "item": lambda a: np.asarray(a).item(),
 }
+
+
+def _decisions_match(a, b):
+    """Compare decision traces; float-valued float()/item() guards get
+    a small relative tolerance — the compiled program may differ from
+    the eager probe by an ulp (fusion/reduction order), and exact
+    equality would ping-pong probe/compiled forever."""
+    if len(a) != len(b):
+        return False
+    for (ka, va), (kb, vb) in zip(a, b):
+        if ka != kb:
+            return False
+        if isinstance(va, float) and isinstance(vb, float):
+            if va != vb and not (
+                    abs(va - vb) <= 1e-6 * max(1.0, abs(va), abs(vb))):
+                return False
+        elif va != vb:
+            return False
+    return True
 
 
 def _scalarize_interceptor(kind, array):
@@ -200,7 +243,8 @@ _static_functions: "weakref.WeakSet" = weakref.WeakSet()
 def _consistent(decisions, observed):
     """True when a spec's decisions agree with an observed (kind, value)
     prefix from another spec's run — same queries up to the shorter."""
-    return all(d == o for d, o in zip(decisions, observed))
+    n = min(len(decisions), len(observed))
+    return _decisions_match(tuple(decisions[:n]), tuple(observed[:n]))
 
 
 class _Spec:
@@ -354,7 +398,7 @@ class StaticFunction:
                         for h, (kind, _) in zip(host, spec.decisions)]
         else:
             observed = []
-        if observed != list(spec.decisions):
+        if not _decisions_match(observed, list(spec.decisions)):
             return False, None, observed
         for t, a in zip(state, new_state):
             t._data = a
@@ -379,7 +423,7 @@ class StaticFunction:
             entry["fallback"] = "graph break outside the Tensor seam"
             return result
         for i, s in enumerate(entry["specs"]):
-            if s.decisions == decisions:
+            if _decisions_match(s.decisions, decisions):
                 entry["mru"] = i
                 return result
         n_float_twins = sum(_float_thrash(decisions, s.decisions)

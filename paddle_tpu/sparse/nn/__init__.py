@@ -21,20 +21,8 @@ from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.nn.layer.layers import Layer
 
 
-def _sp():
-    import paddle_tpu.sparse as sp
-    return sp
-
-
-def _channels_dense(x):
-    """BCOO view with the trailing (channel) dim stored dense — the
-    layout the reference keeps for NDHWC sparse tensors (values carry the
-    channel vector per active site)."""
-    b = x._bcoo
-    if b.n_dense >= 1:
-        return b
-    return jsparse.bcoo_update_layout(b.sum_duplicates(nse=b.nse),
-                                      n_dense=1, on_inefficient=None)
+from . import functional  # noqa: E402
+from .functional import _channels_dense, _sp  # noqa: E402
 
 
 class ReLU(Layer):
@@ -66,17 +54,7 @@ class Softmax(Layer):
         self.axis = axis
 
     def forward(self, x):
-        sp = _sp()
-        b = x._bcoo.sum_duplicates(nse=x._bcoo.nse)
-        rows = b.indices[:, 0]
-        n_rows = b.shape[0]
-        vals = b.data
-        row_max = jax.ops.segment_max(vals, rows, n_rows)
-        vals = jnp.exp(vals - row_max[rows])
-        denom = jax.ops.segment_sum(vals, rows, n_rows)
-        out = vals / denom[rows]
-        return sp.SparseCooTensor._wrap_bcoo(
-            jsparse.BCOO((out, b.indices), shape=b.shape))
+        return functional.softmax(x, self.axis)
 
 
 class BatchNorm(Layer):
@@ -122,34 +100,6 @@ class SyncBatchNorm(BatchNorm):
     is a psum over the dp axis; single-process it equals BatchNorm."""
 
 
-def _conv3d_dense(x, weight, bias, stride, padding, dilation, groups,
-                  subm, data_format="NDHWC"):
-    """Shared dense-compute path for sparse Conv3D/SubmConv3D."""
-    dense = x._bcoo.todense()  # [N, D, H, W, C]
-    lhs = jnp.moveaxis(dense, -1, 1)  # NCDHW
-    w = weight  # [kd, kh, kw, C_in/groups, C_out]
-    rhs = jnp.transpose(w, (4, 3, 0, 1, 2))  # OIDHW
-    st = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
-    dl = (dilation,) * 3 if isinstance(dilation, int) else tuple(dilation)
-    if subm:
-        # submanifold: output spatial size == input; SAME-style padding
-        pads = [((k - 1) * d // 2, (k - 1) * d - (k - 1) * d // 2)
-                for k, d in zip(rhs.shape[2:], dl)]
-        st = (1, 1, 1)
-    elif isinstance(padding, int):
-        pads = [(padding, padding)] * 3
-    else:
-        pads = [(int(p), int(p)) if isinstance(p, (int, np.integer))
-                else tuple(p) for p in padding]
-    out = jax.lax.conv_general_dilated(
-        lhs, rhs, window_strides=st, padding=pads, rhs_dilation=dl,
-        feature_group_count=groups)
-    out = jnp.moveaxis(out, 1, -1)  # NDHWC
-    if bias is not None:
-        out = out + bias
-    return out
-
-
 class Conv3D(Layer):
     """Sparse 3-D conv (reference sparse conv3d). Dense MXU compute; the
     output is re-sparsified from its natural support."""
@@ -174,18 +124,10 @@ class Conv3D(Layer):
         self._subm = False
 
     def forward(self, x):
-        sp = _sp()
         stride, padding, dilation, groups = self._cfg
-        out = _conv3d_dense(x, self.weight._data,
-                            None if self.bias is None else self.bias._data,
-                            stride, padding, dilation, groups, self._subm)
-        if self._subm:
-            # submanifold rule: keep exactly the input's active sites
-            idx = _channels_dense(x).indices  # [nse, 4] over N,D,H,W
-            vals = out[tuple(idx.T)]          # [nse, C_out]
-            bcoo = jsparse.BCOO((vals, idx), shape=out.shape)
-            return sp.SparseCooTensor._wrap_bcoo(bcoo)
-        return sp.to_sparse_coo(Tensor._wrap(out))
+        return functional._conv(
+            x, self.weight, self.bias, stride, padding, dilation,
+            groups, subm=self._subm, ndim=3)
 
 
 class SubmConv3D(Conv3D):
@@ -216,34 +158,10 @@ class Conv2D(Layer):
         self._subm = False
 
     def forward(self, x):
-        sp = _sp()
         stride, padding, dilation, groups = self._cfg
-        dense = x._bcoo.todense()  # [N, H, W, C]
-        lhs = jnp.moveaxis(dense, -1, 1)
-        rhs = jnp.transpose(self.weight._data, (3, 2, 0, 1))
-        st = (stride,) * 2 if isinstance(stride, int) else tuple(stride)
-        dl = (dilation,) * 2 if isinstance(dilation, int) else tuple(dilation)
-        if self._subm:
-            pads = [((k - 1) * d // 2, (k - 1) * d - (k - 1) * d // 2)
-                    for k, d in zip(rhs.shape[2:], dl)]
-            st = (1, 1)
-        elif isinstance(padding, int):
-            pads = [(padding, padding)] * 2
-        else:
-            pads = [(int(p), int(p)) if isinstance(p, (int, np.integer))
-                    else tuple(p) for p in padding]
-        out = jax.lax.conv_general_dilated(
-            lhs, rhs, window_strides=st, padding=pads, rhs_dilation=dl,
-            feature_group_count=groups)
-        out = jnp.moveaxis(out, 1, -1)
-        if self.bias is not None:
-            out = out + self.bias._data
-        if self._subm:
-            idx = _channels_dense(x).indices  # [nse, 3] over N,H,W
-            vals = out[tuple(idx.T)]
-            return sp.SparseCooTensor._wrap_bcoo(
-                jsparse.BCOO((vals, idx), shape=out.shape))
-        return sp.to_sparse_coo(Tensor._wrap(out))
+        return functional._conv(
+            x, self.weight, self.bias, stride, padding, dilation,
+            groups, subm=self._subm, ndim=2)
 
 
 class SubmConv2D(Conv2D):
@@ -263,23 +181,11 @@ class MaxPool3D(Layer):
         self.padding = padding
 
     def forward(self, x):
-        sp = _sp()
-        dense = x._bcoo.todense()  # [N, D, H, W, C]
-        ks = (self.kernel_size,) * 3 if isinstance(self.kernel_size, int) \
-            else tuple(self.kernel_size)
-        st = ks if self.stride is None else (
-            (self.stride,) * 3 if isinstance(self.stride, int)
-            else tuple(self.stride))
-        pd = (self.padding,) * 3 if isinstance(self.padding, int) \
-            else tuple(self.padding)
-        pads = [(0, 0)] + [(p, p) for p in pd] + [(0, 0)]
-        out = jax.lax.reduce_window(
-            dense, -jnp.inf, jax.lax.max,
-            (1,) + ks + (1,), (1,) + st + (1,), pads)
-        out = jnp.where(jnp.isfinite(out), out, 0.0)
-        return sp.to_sparse_coo(Tensor._wrap(out))
+        return functional.max_pool3d(x, self.kernel_size, self.stride,
+                                     self.padding)
 
 
-__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm",
+__all__ = ["functional",
+           "ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm",
            "SyncBatchNorm", "Conv2D", "Conv3D", "SubmConv2D", "SubmConv3D",
            "MaxPool3D"]

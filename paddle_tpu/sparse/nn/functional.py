@@ -1,0 +1,219 @@
+"""paddle.sparse.nn.functional — functional forms of the sparse nn ops
+(reference: python/paddle/sparse/nn/functional/{conv,pooling,activation,
+transformer}.py over the phi sparse CUDA kernels).
+
+TPU-native design: the reference's gather-gemm-scatter sparse conv
+kernels exist because CUDA needs explicit site lists; on TPU the MXU
+wants large dense contractions, so conv/pool densify the block, run the
+XLA op, and re-sparsify (submanifold rule: output support == input
+support — applied as a gather at the input's active sites). The
+`_igemm` variants are therefore the same computation here (the suffix
+selects an implicit-gemm CUDA kernel in the reference).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = [
+    "conv2d", "conv3d", "subm_conv2d", "subm_conv2d_igemm",
+    "subm_conv3d", "subm_conv3d_igemm", "max_pool3d",
+    "relu", "relu6", "leaky_relu", "softmax", "attention",
+]
+
+
+def _sp():
+    import paddle_tpu.sparse as sp
+    return sp
+
+
+def _channels_dense(x):
+    """BCOO view with the trailing (channel) dim stored dense — the
+    layout the reference keeps for NDHWC/NHWC sparse tensors."""
+    b = x._bcoo
+    if b.n_dense >= 1:
+        return b
+    return jsparse.bcoo_update_layout(b.sum_duplicates(nse=b.nse),
+                                      n_dense=1, on_inefficient=None)
+
+
+def _norm_tuple(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+def _conv_dense(x, weight, bias, stride, padding, dilation, groups,
+                subm, ndim):
+    """Shared dense-compute path: NHWC/NDHWC sparse in, dense out."""
+    dense = x._bcoo.todense()                 # [N, *spatial, C]
+    lhs = jnp.moveaxis(dense, -1, 1)          # NC*spatial
+    w = weight._data if isinstance(weight, Tensor) else weight
+    # weight layout [*k, C_in/groups, C_out] -> OI*spatial
+    perm = (ndim + 1, ndim) + tuple(range(ndim))
+    rhs = jnp.transpose(w, perm)
+    st = _norm_tuple(stride, ndim)
+    dl = _norm_tuple(dilation, ndim)
+    if subm:
+        # submanifold: output spatial size == input; SAME-style padding
+        pads = [((k - 1) * d // 2, (k - 1) * d - (k - 1) * d // 2)
+                for k, d in zip(rhs.shape[2:], dl)]
+        st = (1,) * ndim
+    elif isinstance(padding, int):
+        pads = [(padding, padding)] * ndim
+    else:
+        pads = [(int(p), int(p)) if isinstance(p, (int, np.integer))
+                else tuple(p) for p in padding]
+    out = jax.lax.conv_general_dilated(
+        lhs, rhs, window_strides=st, padding=pads, rhs_dilation=dl,
+        feature_group_count=groups)
+    out = jnp.moveaxis(out, 1, -1)            # [N, *spatial, C_out]
+    if bias is not None:
+        b = bias._data if isinstance(bias, Tensor) else bias
+        out = out + b
+    return out
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, subm,
+          ndim):
+    sp = _sp()
+    out = _conv_dense(x, weight, bias, stride, padding, dilation,
+                      groups, subm, ndim)
+    if subm:
+        # submanifold rule: keep exactly the input's active sites
+        idx = _channels_dense(x).indices      # [nse, 1+ndim]
+        vals = out[tuple(idx.T)]              # [nse, C_out]
+        return sp.SparseCooTensor._wrap_bcoo(
+            jsparse.BCOO((vals, idx), shape=out.shape))
+    return sp.to_sparse_coo(Tensor._wrap(out))
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NDHWC", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 subm=False, ndim=3)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 subm=True, ndim=3)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NHWC", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 subm=False, ndim=2)
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 subm=True, ndim=2)
+
+
+# the reference's *_igemm variants pick an implicit-gemm CUDA kernel
+# for the same math; on TPU the XLA conv already is the gemm form
+subm_conv2d_igemm = subm_conv2d
+subm_conv3d_igemm = subm_conv3d
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format="NDHWC", name=None):
+    sp = _sp()
+    dense = x._bcoo.todense()                 # [N, D, H, W, C]
+    ks = _norm_tuple(kernel_size, 3)
+    st = ks if stride is None else _norm_tuple(stride, 3)
+    pd = _norm_tuple(padding, 3)
+    pads = [(0, 0)] + [(p, p) for p in pd] + [(0, 0)]
+    out = jax.lax.reduce_window(
+        dense, -jnp.inf, jax.lax.max,
+        (1,) + ks + (1,), (1,) + st + (1,), pads)
+    out = jnp.where(jnp.isfinite(out), out, 0.0)
+    return sp.to_sparse_coo(Tensor._wrap(out))
+
+
+# ------------------------------------------------------------ activations
+# value-wise activations: delegate to the single _on_values
+# implementations in paddle_tpu.sparse (which also handle the dense-
+# Tensor fallback) — one home for the semantics
+def relu(x, name=None):
+    return _sp().relu(x)
+
+
+def relu6(x, name=None):
+    return _sp().relu6(x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _sp().leaky_relu(x, negative_slope)
+
+
+def softmax(x, axis=-1, name=None):
+    """Softmax over the stored entries of each row (the reference
+    kernel's semantics: missing entries are NOT treated as zeros)."""
+    sp = _sp()
+    if axis not in (-1, x.ndim - 1):
+        raise ValueError("sparse softmax supports the last axis only")
+    dense = x._bcoo.todense()
+    # int8 ones: BCOO.todense scatter-adds, which rejects bool
+    mask = jsparse.BCOO(
+        (jnp.ones_like(x._bcoo.data, jnp.int8), x._bcoo.indices),
+        shape=x._bcoo.shape).todense() != 0
+    logits = jnp.where(mask, dense, -jnp.inf)
+    out = jax.nn.softmax(logits, axis=-1)
+    out = jnp.where(mask, out, 0.0)
+    bcoo = jsparse.BCOO.fromdense(out, nse=x._bcoo.nse)
+    return sp.SparseCooTensor._wrap_bcoo(bcoo, x.stop_gradient)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse-pattern attention (reference sparse/nn/functional/
+    transformer.py:28): softmax(QK^T/sqrt(d)) restricted to the mask's
+    sparsity pattern, then @V.
+
+    q/k/v: dense [B, H, S, D]; sparse_mask: a sparse tensor (or dense
+    Tensor) whose dense shape is [B*H, S, S] — only positions present
+    in its pattern participate in the row softmax. key_padding_mask
+    [B, S] and attn_mask [S, S] multiply additional positions out (the
+    reference's semantics: a 0 masks, a 1 keeps).
+
+    TPU-native: the pattern becomes a boolean mask fused into a dense
+    masked softmax — XLA keeps it in the attention epilogue; the CSR
+    format is an input-format contract, not the compute layout.
+    """
+    q = query._data if isinstance(query, Tensor) else jnp.asarray(query)
+    k = key._data if isinstance(key, Tensor) else jnp.asarray(key)
+    v = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+    b, h, s, d = q.shape
+    if hasattr(sparse_mask, "_bcoo"):
+        pattern = jsparse.BCOO(
+            (jnp.ones_like(sparse_mask._bcoo.data, jnp.int8),
+             sparse_mask._bcoo.indices),
+            shape=sparse_mask._bcoo.shape).todense() != 0
+    else:
+        pattern = (sparse_mask._data if isinstance(sparse_mask, Tensor)
+                   else jnp.asarray(sparse_mask)) != 0
+    pattern = pattern.reshape(b, h, s, s)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(d)
+    keep = pattern
+    if key_padding_mask is not None:
+        kp = (key_padding_mask._data
+              if isinstance(key_padding_mask, Tensor)
+              else jnp.asarray(key_padding_mask))
+        keep = keep & (kp != 0)[:, None, None, :]
+    if attn_mask is not None:
+        am = (attn_mask._data if isinstance(attn_mask, Tensor)
+              else jnp.asarray(attn_mask))
+        keep = keep & (am != 0)[None, None, :, :]
+    logits = jnp.where(keep, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    probs = jnp.where(keep, probs, 0.0)       # all-masked rows -> 0
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return Tensor._wrap(out, stop_gradient=all(
+        getattr(t, "stop_gradient", True) for t in (query, key, value)))

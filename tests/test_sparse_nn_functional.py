@@ -1,0 +1,180 @@
+"""paddle.sparse.nn.functional oracle tests (reference:
+python/paddle/sparse/nn/functional/{conv,pooling,activation,
+transformer}.py).
+
+Oracles: torch dense conv/pool on the densified input (independent of
+the jax implementation path), numpy masked-softmax for attention, and
+the submanifold support-preservation invariant.
+"""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sp
+
+F = sp.nn.functional
+
+
+def _rand_sparse_ndhwc(shape, density=0.3, seed=0):
+    rng = np.random.RandomState(seed)
+    dense = rng.randn(*shape).astype(np.float32)
+    mask = rng.rand(*shape[:-1]) < density      # site-level sparsity
+    dense = dense * mask[..., None]
+    return sp.to_sparse_coo(paddle.to_tensor(dense),
+                            sparse_dim=len(shape) - 1), dense
+
+
+def test_conv3d_matches_torch_dense():
+    xs, dense = _rand_sparse_ndhwc((2, 6, 6, 6, 3))
+    rng = np.random.RandomState(1)
+    w = rng.randn(3, 3, 3, 3, 5).astype(np.float32) * 0.1
+    b = rng.randn(5).astype(np.float32)
+    out = F.conv3d(xs, paddle.to_tensor(w), paddle.to_tensor(b),
+                   stride=2, padding=1).to_dense().numpy()
+    ref = torch.nn.functional.conv3d(
+        torch.tensor(dense).permute(0, 4, 1, 2, 3),
+        torch.tensor(w).permute(4, 3, 0, 1, 2), torch.tensor(b),
+        stride=2, padding=1).permute(0, 2, 3, 4, 1).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_conv2d_matches_torch_dense():
+    xs, dense = _rand_sparse_ndhwc((2, 8, 8, 3))
+    rng = np.random.RandomState(2)
+    w = rng.randn(3, 3, 3, 4).astype(np.float32) * 0.1
+    out = F.conv2d(xs, paddle.to_tensor(w), stride=1,
+                   padding=1).to_dense().numpy()
+    ref = torch.nn.functional.conv2d(
+        torch.tensor(dense).permute(0, 3, 1, 2),
+        torch.tensor(w).permute(3, 2, 0, 1),
+        stride=1, padding=1).permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("fn", [F.subm_conv3d, F.subm_conv3d_igemm])
+def test_subm_conv3d_support_and_values(fn):
+    xs, dense = _rand_sparse_ndhwc((1, 5, 5, 5, 2), density=0.2)
+    rng = np.random.RandomState(3)
+    w = rng.randn(3, 3, 3, 2, 4).astype(np.float32) * 0.1
+    out = fn(xs, paddle.to_tensor(w))
+    # 1) submanifold rule: output support == input support
+    in_sites = {tuple(r) for r in np.asarray(xs.indices().numpy()).T}
+    out_sites = {tuple(r[:4]) for r in
+                 np.asarray(out._bcoo.indices)}
+    assert out_sites == in_sites
+    # 2) values at active sites match the torch dense conv there
+    ref = torch.nn.functional.conv3d(
+        torch.tensor(dense).permute(0, 4, 1, 2, 3),
+        torch.tensor(w).permute(4, 3, 0, 1, 2),
+        padding=1).permute(0, 2, 3, 4, 1).numpy()
+    out_d = out.to_dense().numpy()
+    for site in in_sites:
+        np.testing.assert_allclose(out_d[site], ref[site],
+                                   rtol=1e-4, atol=1e-5)
+    # 3) inactive sites stay exactly zero
+    inactive = np.ones(out_d.shape[:4], bool)
+    for site in in_sites:
+        inactive[site] = False
+    assert np.all(out_d[inactive] == 0)
+
+
+def test_subm_conv2d_support_preserved():
+    xs, _ = _rand_sparse_ndhwc((2, 6, 6, 3), density=0.25, seed=5)
+    rng = np.random.RandomState(4)
+    w = rng.randn(3, 3, 3, 6).astype(np.float32)
+    for fn in (F.subm_conv2d, F.subm_conv2d_igemm):
+        out = fn(xs, paddle.to_tensor(w))
+        in_sites = {tuple(r) for r in np.asarray(xs.indices().numpy()).T}
+        out_sites = {tuple(r[:3]) for r in np.asarray(out._bcoo.indices)}
+        assert out_sites == in_sites
+
+
+def test_max_pool3d_matches_torch():
+    xs, dense = _rand_sparse_ndhwc((2, 4, 4, 4, 3), density=0.5, seed=6)
+    out = F.max_pool3d(xs, 2).to_dense().numpy()
+    ref = torch.nn.functional.max_pool3d(
+        torch.tensor(dense).permute(0, 4, 1, 2, 3), 2)
+    ref = ref.permute(0, 2, 3, 4, 1).numpy()
+    # empty windows densify to 0 on the sparse path; torch sees the
+    # zeros too (dense holds 0 at inactive sites) — only positive
+    # entries can differ... they cannot: max(0, negatives)=0 both ways
+    np.testing.assert_allclose(out, np.maximum(ref, 0), atol=1e-6)
+
+
+def test_activations_value_semantics():
+    x = sp.sparse_coo_tensor([[0, 0, 1], [0, 2, 1]],
+                             [-3.0, 7.5, 2.0], (2, 3))
+    np.testing.assert_allclose(F.relu(x).values().numpy(), [0, 7.5, 2])
+    np.testing.assert_allclose(F.relu6(x).values().numpy(), [0, 6, 2])
+    np.testing.assert_allclose(F.leaky_relu(x, 0.1).values().numpy(),
+                               [-0.3, 7.5, 2])
+
+
+def test_functional_softmax_stored_entries_only():
+    x = sp.sparse_coo_tensor([[0, 0, 1], [0, 2, 1]],
+                             [1.0, 3.0, 2.0], (2, 3))
+    out = F.softmax(x).to_dense().numpy()
+    e = np.exp([1.0, 3.0])
+    np.testing.assert_allclose(out[0, [0, 2]], e / e.sum(), rtol=1e-6)
+    np.testing.assert_allclose(out[1, 1], 1.0)
+    assert out[0, 1] == 0          # missing entry stays structurally 0
+
+
+def _np_masked_attention(q, k, v, keep):
+    d = q.shape[-1]
+    logits = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+    logits = np.where(keep, logits, -np.inf)
+    m = logits.max(-1, keepdims=True)
+    p = np.exp(logits - m)
+    p = np.where(keep, p, 0.0)
+    p = p / np.maximum(p.sum(-1, keepdims=True), 1e-30)
+    return np.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def test_sparse_attention_vs_numpy_oracle():
+    rng = np.random.RandomState(7)
+    b, h, s, d = 2, 2, 8, 4
+    q, k, v = (rng.randn(b, h, s, d).astype(np.float32)
+               for _ in range(3))
+    keep = rng.rand(b * h, s, s) < 0.6
+    keep |= np.eye(s, dtype=bool)[None]        # no empty rows
+    # pattern as a sparse COO mask with dense shape [B*H, S, S]
+    idx = np.stack(np.nonzero(keep))
+    mask = sp.sparse_coo_tensor(idx, np.ones(idx.shape[1], np.float32),
+                                keep.shape)
+    out = F.attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                      paddle.to_tensor(v), mask).numpy()
+    ref = _np_masked_attention(q, k, v, keep.reshape(b, h, s, s))
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_attention_padding_and_attn_masks():
+    rng = np.random.RandomState(8)
+    b, h, s, d = 1, 2, 6, 4
+    q, k, v = (rng.randn(b, h, s, d).astype(np.float32)
+               for _ in range(3))
+    full = np.ones((b * h, s, s), bool)
+    idx = np.stack(np.nonzero(full))
+    mask = sp.sparse_coo_tensor(idx, np.ones(idx.shape[1], np.float32),
+                                full.shape)
+    kp = np.ones((b, s), np.float32)
+    kp[:, -2:] = 0                             # pad out last two keys
+    am = np.tril(np.ones((s, s), np.float32))  # causal
+    out = F.attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                      paddle.to_tensor(v), mask,
+                      key_padding_mask=paddle.to_tensor(kp),
+                      attn_mask=paddle.to_tensor(am)).numpy()
+    keep = (full.reshape(b, h, s, s)
+            & (kp != 0)[:, None, None, :]
+            & (am != 0)[None, None])
+    ref = _np_masked_attention(q, k, v, keep)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_layers_delegate_to_functional():
+    xs, _ = _rand_sparse_ndhwc((1, 4, 4, 4, 2), density=0.4, seed=9)
+    layer = sp.nn.SubmConv3D(2, 3, 3)
+    out_layer = layer(xs).to_dense().numpy()
+    out_fn = F.subm_conv3d(xs, layer.weight, layer.bias).to_dense().numpy()
+    np.testing.assert_allclose(out_layer, out_fn)
