@@ -1,0 +1,128 @@
+"""Peak-memory probe: compiled GPipe (jax.grad over the forward
+pipeline) vs compiled 1F1B (parallel/pipeline_1f1b) at pp=4, M=8 —
+the VERDICT round-1 item-6 measurement.
+
+Run on the 8-virtual-device CPU mesh:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python benchmarks/_pp_memory_probe.py [M] [HID]
+
+Reports XLA's compiled temp-buffer sizes (memory_analysis()) per
+variant, plus the analytic live-activation counts from the schedule
+descriptors. The GPipe backward is grad-of-scan: XLA must keep the
+per-tick stage inputs for all M+N-1 ticks alive across the whole
+backward; 1F1B's explicit interleave keeps a 2N-1-deep ring instead,
+so its activation term is flat in M.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from paddle_tpu._testing import unshim_axon
+    unshim_axon()
+except Exception:
+    pass
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+from jax.experimental.shard_map import shard_map  # noqa: E402
+
+from paddle_tpu.parallel.pipeline import (pipeline_apply,  # noqa: E402
+                                          stack_stage_params)
+from paddle_tpu.parallel.pipeline_1f1b import (  # noqa: E402
+    compiled_1f1b_schedule, pipeline_train_1f1b)
+from paddle_tpu.parallel.pp_schedule import schedule_fthenb  # noqa: E402
+
+N = 4
+
+
+def build(m, hid):
+    rng = np.random.RandomState(0)
+    stages = [{"w1": jnp.asarray(rng.randn(hid, hid) * 0.02, jnp.float32),
+               "w2": jnp.asarray(rng.randn(hid, hid) * 0.02, jnp.float32)}
+              for _ in range(N)]
+    mb = jnp.asarray(rng.randn(m, 4, 128, hid) * 0.1, jnp.float32)
+    stacked = stack_stage_params(stages)
+    mesh = Mesh(np.asarray(jax.devices()[:N]), ("pp",))
+    return stacked, mb, mesh
+
+
+def stage_fn(params, x):
+    return jnp.tanh(x @ params["w1"]) @ params["w2"] + x
+
+
+def gpipe_grad_fn(stacked, mb, mesh):
+    specs = jax.tree_util.tree_map(lambda _: P("pp"), stacked)
+
+    def loss(stacked, mb):
+        def body(stacked, mb):
+            out = pipeline_apply(jax.checkpoint(stage_fn), stacked, mb)
+            return out
+        out = shard_map(body, mesh=mesh, in_specs=(specs, P(None)),
+                        out_specs=P(None))(stacked, mb)
+        return jnp.mean(out ** 2)
+
+    return jax.jit(jax.grad(loss))
+
+
+def f1b_fn(stacked, mb, mesh):
+    specs = jax.tree_util.tree_map(lambda _: P("pp"), stacked)
+
+    def body(stacked, mb):
+        def last_grad(y, _hp, _mb_idx):
+            l, dy = jax.value_and_grad(
+                lambda y_: jnp.mean(y_ ** 2) * mb.shape[0])(y)
+            return l / mb.shape[0], dy / mb.shape[0], None
+        loss, grads, _, _ = pipeline_train_1f1b(
+            stage_fn, stacked, mb, last_grad)
+        return loss, grads
+
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=(specs, P(None)),
+                             out_specs=(P(), specs)))
+
+
+def mem_stats(jitted, *args):
+    compiled = jitted.lower(*args).compile()
+    ma = compiled.memory_analysis()
+    if ma is None:
+        return None
+    return {"temp_MB": ma.temp_size_in_bytes / 2**20,
+            "arg_MB": ma.argument_size_in_bytes / 2**20,
+            "out_MB": ma.output_size_in_bytes / 2**20}
+
+
+def main():
+    m = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    hid = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    stacked, mb, mesh = build(m, hid)
+    act_mb = mb[0].size * 4 / 2**20
+
+    g = gpipe_grad_fn(stacked, mb, mesh)
+    s_g = mem_stats(g, stacked, mb)
+    f = f1b_fn(stacked, mb, mesh)
+    s_f = mem_stats(f, stacked, mb)
+
+    print(f"pp={N} M={m} hid={hid} per-microbatch activation "
+          f"= {act_mb:.2f} MB")
+    print(f"schedule peak activations: gpipe/FThenB="
+          f"{schedule_fthenb(N, m).peak_activations()}  compiled-1F1B="
+          f"{compiled_1f1b_schedule(N, m).peak_activations()}")
+    print(f"gpipe grad-of-scan:  {s_g}")
+    print(f"compiled 1F1B:       {s_f}")
+    if s_g and s_f:
+        win = s_g["temp_MB"] / max(s_f["temp_MB"], 1e-9)
+        print(f"temp-memory ratio gpipe/1f1b = {win:.2f}x")
+
+
+if __name__ == "__main__":
+    main()

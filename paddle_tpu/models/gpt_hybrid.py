@@ -46,6 +46,11 @@ class ParallelConfig:
     sp: bool = False          # sequence-shard activations over tp axis
     num_experts: int = 0      # >0 turns MLP into MoE (EP over dp axis)
     microbatches: int = 1     # pipeline microbatches (pp>1)
+    # "gpipe": forward rotation + jax.grad (activation liveness grows
+    # with microbatches); "1f1b": explicit forward/backward interleave
+    # with O(pp) liveness (parallel/pipeline_1f1b.py — the compiled
+    # analog of the reference 1F1B, pipeline_parallel.py:547)
+    pp_schedule: str = "gpipe"
     remat: bool = True
     # remat granularity: "full" recomputes the whole block (min memory);
     # "dots" saves matmul/einsum outputs and recomputes only elementwise
@@ -353,28 +358,40 @@ def forward(params, input_ids, cfg: GPTConfig, pcfg: ParallelConfig,
                       params["wte"].astype(pcfg.compute_dtype))
 
 
-def loss_fn(params, batch, cfg, pcfg, mesh):
-    input_ids, labels = batch
+def _ce_from_hidden(h, wte, labels, pcfg):
+    """Next-token CE from the final (post-LN) hidden states [b, s, hid]
+    — the single home of the LM-head+loss math, shared by loss_fn and
+    the compiled-1F1B last-stage head."""
+    b, s, hid = h.shape
     if pcfg.fused_ce:
         from paddle_tpu.ops.fused_ce import fused_lm_ce
-        x = forward_hidden(params, input_ids, cfg, pcfg, mesh)
-        b, s, h = x.shape
         # next-token targets with the final position masked out
         tgt = jnp.concatenate([labels[:, 1:],
                                jnp.zeros((b, 1), labels.dtype)], axis=1)
         mask = jnp.concatenate(
             [jnp.ones((b, s - 1), jnp.float32),
              jnp.zeros((b, 1), jnp.float32)], axis=1)
-        w = params["wte"].astype(x.dtype)
-        return fused_lm_ce(x.reshape(b * s, h), w,
+        # the mask must carry h's varying spec at the custom-vjp
+        # boundary: its cotangent is computed from h-derived values, and
+        # shard_map manual-axis type checking rejects a varying
+        # cotangent against an unvarying (literal) primal
+        mask = mask + h.ravel()[0].astype(jnp.float32) * 0
+        return fused_lm_ce(h.reshape(b * s, hid), wte.astype(h.dtype),
                            tgt.reshape(b * s), mask.reshape(b * s))
-    logits = forward(params, input_ids, cfg, pcfg, mesh)
+    logits = jnp.einsum("bsh,vh->bsv", h, wte.astype(h.dtype))
     logits = logits[:, :-1].astype(jnp.float32)
     tgt = labels[:, 1:]
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     picked = jnp.take_along_axis(logits, tgt[..., None],
                                  axis=-1)[..., 0]
     return jnp.mean(logz - picked)
+
+
+def loss_fn(params, batch, cfg, pcfg, mesh):
+    input_ids, labels = batch
+    # forward_hidden already applies the final layer norm
+    x = forward_hidden(params, input_ids, cfg, pcfg, mesh)
+    return _ce_from_hidden(x, params["wte"], labels, pcfg)
 
 
 # --------------------------- optimizer -------------------------------------
@@ -425,8 +442,89 @@ def adamw_update(params, grads, opt_state, lr=3e-4, b1=0.9, b2=0.95,
 
 
 # --------------------------- train step ------------------------------------
+def _train_grads_1f1b(params, batch, cfg, pcfg, mesh):
+    """Loss + grads via the compiled-1F1B pipeline (O(pp) activation
+    liveness — parallel/pipeline_1f1b.py) instead of jax.grad over the
+    GPipe rotation. Embedding runs (and is differentiated) outside the
+    pipeline; the head (final LN + logits + CE) is the pipeline's
+    last-stage seed, with tied-wte grads summed from both paths."""
+    from jax import shard_map
+
+    from paddle_tpu.parallel.pipeline import pipeline_microbatch
+    from paddle_tpu.parallel.pipeline_1f1b import pipeline_train_1f1b
+
+    input_ids, labels = batch
+    cdt = pcfg.compute_dtype
+    b, s = input_ids.shape
+    m = pcfg.microbatches
+
+    def embed(wte, wpe):
+        return wte[input_ids].astype(cdt) + wpe[:s][None].astype(cdt)
+
+    x, embed_vjp = jax.vjp(embed, params["wte"], params["wpe"])
+    x = _constrain(x, P("dp", None, None), mesh)
+    mb = pipeline_microbatch(x, m)
+    lbl_mb = pipeline_microbatch(labels, m)
+    blocks = jax.tree_util.tree_map(lambda p: p.astype(cdt),
+                                    params["blocks"])
+    head_params = {"wte": params["wte"], "lnf_g": params["lnf_g"],
+                   "lnf_b": params["lnf_b"]}
+
+    def stage_fn(stage_params, xm):
+        return _stack_apply(stage_params, xm, cfg, pcfg, mesh)
+
+    def body(blocks, mb, lbl_mb, head_params):
+        def last_grad(y, hp, mb_idx):
+            # mb_idx is device-varying, so this gather (and everything
+            # derived from lbl) is too — matching y's spec
+            lbl = lbl_mb[mb_idx]
+
+            def head_loss(hp_, y_):
+                h = _layer_norm(y_, hp_["lnf_g"].astype(cdt),
+                                hp_["lnf_b"].astype(cdt))
+                return _ce_from_hidden(h, hp_["wte"], lbl, pcfg) / m
+
+            (l, (ghp, gy)) = jax.value_and_grad(
+                head_loss, argnums=(0, 1))(hp, y)
+            return l, gy, ghp
+
+        return pipeline_train_1f1b(stage_fn, blocks, mb, last_grad,
+                                   head_params=head_params)
+
+    blk_specs = jax.tree_util.tree_map(lambda _: P("pp"), blocks)
+    loss, bgrads, hgrads, dx0 = shard_map(
+        body, mesh=mesh, axis_names={"pp"},
+        in_specs=(blk_specs, P(None), P(None), P(None)),
+        out_specs=(P(), blk_specs, P(), P(None)))(
+            blocks, mb, lbl_mb, head_params)
+
+    dwte_e, dwpe = embed_vjp(dx0.reshape(b, s, -1).astype(x.dtype))
+    grads = {
+        "wte": dwte_e.astype(jnp.float32) + hgrads["wte"],
+        "wpe": dwpe.astype(jnp.float32),
+        "blocks": bgrads,
+        "lnf_g": hgrads["lnf_g"],
+        "lnf_b": hgrads["lnf_b"],
+    }
+    return loss, grads
+
+
 def build_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
                      lr=3e-4):
+    if pcfg.pp_schedule not in ("gpipe", "1f1b"):
+        raise ValueError(
+            f"pp_schedule must be 'gpipe' or '1f1b', got "
+            f"{pcfg.pp_schedule!r}")
+    if pcfg.pp > 1 and pcfg.pp_schedule == "1f1b":
+        def train_step(params, opt_state, batch):
+            loss, grads = _train_grads_1f1b(params, batch, cfg, pcfg,
+                                            mesh)
+            new_params, new_opt = adamw_update(params, grads, opt_state,
+                                               lr=lr)
+            return new_params, new_opt, loss
+
+        return jax.jit(train_step, donate_argnums=(0, 1))
+
     def train_step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(
             lambda p: loss_fn(p, batch, cfg, pcfg, mesh))(params)

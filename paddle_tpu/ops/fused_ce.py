@@ -72,8 +72,11 @@ def _fwd(x, w, targets, weights):
         picked = jnp.take_along_axis(logits, ti[:, None], axis=-1)[:, 0]
         return carry + jnp.sum(wi * (logz - picked)), (logz, picked)
 
-    total, (logz, picked) = lax.scan(
-        body, jnp.zeros((), jnp.float32), (xc, tc, wc))
+    # carry init derived from the inputs so it inherits their varying
+    # spec when traced inside shard_map manual axes (a literal zero
+    # would be unvarying and fail the scan vma check)
+    zero = (xc.ravel()[0] * 0 + wc.ravel()[0] * 0).astype(jnp.float32)
+    total, (logz, picked) = lax.scan(body, zero, (xc, tc, wc))
     denom = jnp.sum(weights.astype(jnp.float32))
     safe = jnp.where(denom > 0, denom, 1.0)
     loss = jnp.where(denom > 0, total / safe, 0.0)
@@ -107,8 +110,9 @@ def _bwd(res, g):
                              preferred_element_type=jnp.float32)
         return dw, dxi.astype(x.dtype)
 
-    dw, dxc = lax.scan(body, jnp.zeros(w.shape, jnp.float32),
-                       (xc, tc, zc, sc))
+    dw0 = jnp.zeros(w.shape, jnp.float32) + \
+        (xc.ravel()[0] * 0 + sc.ravel()[0] * 0)   # varying-spec inherit
+    dw, dxc = lax.scan(body, dw0, (xc, tc, zc, sc))
     # d loss / d w_i = (ce_i - loss) / denom  (quotient rule)
     ce = logz - picked
     loss = jnp.sum(wf * ce) / safe

@@ -1,0 +1,179 @@
+"""Compiled 1F1B: forward/backward-interleaved pipeline in ONE XLA
+program with O(stages) activation liveness.
+
+Reference being re-designed: PipelineParallel.forward_backward_pipeline
+(fleet/meta_parallel/pipeline_parallel.py:547) — the host-driven 1F1B
+loop whose point is bounding live activations at pipeline depth instead
+of the microbatch count.
+
+Why the GPipe-compiled path (parallel/pipeline.py) cannot bound memory:
+its backward is jax.grad of a forward scan, and grad-of-scan saves the
+per-tick residuals for ALL M+N-1 ticks — activation liveness grows with
+M exactly like host GPipe. Here the backward is written explicitly:
+
+  one lax.scan over T = M + 2(N-1) clock ticks; at tick t stage s
+    F:  computes microbatch  m_f = t - s                (0 <= m_f < M)
+    B:  computes microbatch  m_b = t - 2(N-1) + s       (0 <= m_b < M)
+  activations hop forward with collective-permute, cotangents hop
+  backward with the reverse permute, and each stage keeps a RING BUFFER
+  of K = 2(N-1)+1 stage inputs — the in-flight window of the schedule.
+  Backward recomputes the stage forward under jax.vjp from the stashed
+  input (stage-granular rematerialization), so residuals are tick-local.
+
+Peak live activations per stage: 2(N-1-s)+1 <= 2N-1, independent of M
+(vs M for F-then-B/GPipe) — the same bound class as host 1F1B, achieved
+with compiled collectives instead of NCCL p2p + host scheduling.
+
+Trade-offs (documented, measured in benchmarks/_pp_memory_probe.py):
+ramp ticks execute masked compute (SPMD stages run one program), so
+wall-clock efficiency is M/(M+2(N-1)) per leg — the usual pipeline
+bubble; and the last-stage head/loss runs (masked) on every stage.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.parallel.pp_schedule import PipeOp, Schedule
+
+
+def compiled_1f1b_schedule(n_stages: int, n_microbatches: int) -> Schedule:
+    """The (stage, tick) -> op timeline this module compiles, as a
+    pp_schedule.Schedule — so its dependency validity, makespan and
+    peak-activation bound are checkable with the same machinery as the
+    host schedules (the VERDICT 'schedule equivalence' artifact)."""
+    n, m = n_stages, n_microbatches
+    per_stage = []
+    for s in range(n):
+        ops = []
+        for t in range(m + 2 * (n - 1)):
+            mf = t - s
+            if 0 <= mf < m:
+                ops.append(PipeOp("F", s, mf))
+            mb = t - 2 * (n - 1) + s
+            if 0 <= mb < m:
+                ops.append(PipeOp("B", s, mb))
+        per_stage.append(ops)
+    return Schedule("compiled-1F1B", n, m, per_stage)
+
+
+def pipeline_train_1f1b(stage_fn: Callable, stage_params, x_microbatches,
+                        last_stage_grad: Callable,
+                        head_params=None,
+                        axis_name: str = "pp",
+                        grad_dtype=jnp.float32):
+    """Run the interleaved pipeline inside shard_map.
+
+    stage_fn(params, x) -> y                   same signature per stage
+    stage_params: pytree with leading dim 1 on each device (stage-
+        stacked weights sharded over `axis_name`, as inside shard_map)
+    x_microbatches: [M, ...] microbatched stage-0 input (replicated)
+    last_stage_grad(y, head_params, mb_idx) -> (loss, dy, head_grads):
+        the head + loss on the final stage's output; mb_idx is the
+        microbatch index of this y (clipped during masked ramp ticks —
+        use it to fetch labels/targets); dy is dLoss/dy. head_grads may
+        be None. Runs (masked) on every stage per tick.
+    head_params: the pytree handed to last_stage_grad. It is pcast to
+        device-varying FIRST — differentiating wrt a replicated
+        (unvarying) value inside shard_map inserts an automatic psum in
+        the transpose, which would leak every stage's masked garbage
+        head-gradients into the last stage's. Do NOT close over head
+        weights inside last_stage_grad; pass them here.
+
+    Returns (loss_total, stage_param_grads [leading dim 1],
+             head_grads_total, dx0 [M, ...] input cotangents at stage 0)
+    """
+    n = lax.axis_size(axis_name)
+    s = lax.axis_index(axis_name)
+    m = x_microbatches.shape[0]
+    t_total = m + 2 * (n - 1)
+    k = 2 * (n - 1) + 1
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+    bwd_perm = [((i + 1) % n, i) for i in range(n)]
+
+    my_params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+
+    def _varying(x):
+        return lax.pcast(x, (axis_name,), to="varying")
+
+    head_params_v = (None if head_params is None else
+                     jax.tree_util.tree_map(_varying, head_params))
+
+    x_shape = x_microbatches.shape[1:]
+    dtype = x_microbatches.dtype
+    act0 = _varying(jnp.zeros(x_shape, dtype))
+    cot0 = _varying(jnp.zeros(x_shape, dtype))
+    stash0 = _varying(jnp.zeros((k,) + x_shape, dtype))
+    grads0 = jax.tree_util.tree_map(
+        lambda p: _varying(jnp.zeros(p.shape, grad_dtype)), my_params)
+    # structure probe (unused outputs are DCE'd by XLA)
+    _, _, probe_hg = last_stage_grad(jnp.zeros(x_shape, dtype),
+                                     head_params_v, jnp.zeros((), jnp.int32))
+    head0 = None if probe_hg is None else jax.tree_util.tree_map(
+        lambda g: _varying(jnp.zeros(g.shape, grad_dtype)), probe_hg)
+    dx0_buf0 = _varying(jnp.zeros((m,) + x_shape, dtype))
+
+    def tick(carry, t):
+        act_in, cot_in, stash, grads, head, loss, dx0_buf = carry
+        # ---------------- forward leg: microbatch m_f = t - s
+        mf = t - s
+        f_active = (mf >= 0) & (mf < m)
+        f_act = jnp.where(s == 0, x_microbatches[jnp.clip(mf, 0, m - 1)],
+                          act_in)
+        y = stage_fn(my_params, f_act)
+        # stash this tick's stage input (ring slot t mod K) BEFORE the
+        # backward read: the last stage's B reads its own tick's slot
+        stash = lax.dynamic_update_index_in_dim(
+            stash, f_act, jnp.mod(t, k), 0)
+        # ---------------- last-stage seed: loss + dLoss/dy of THIS y
+        loss_mb, dy_seed, hgrads = last_stage_grad(
+            y, head_params_v, jnp.clip(mf, 0, m - 1))
+        is_last = s == n - 1
+        # ---------------- backward leg: microbatch m_b = t - 2(N-1) + s
+        mb = t - 2 * (n - 1) + s
+        b_active = (mb >= 0) & (mb < m)
+        cot = jnp.where(is_last, dy_seed, cot_in)
+        x_b = stash[jnp.mod(t - 2 * (n - 1 - s), k)]
+        _, vjp = jax.vjp(stage_fn, my_params, x_b)
+        dp, dx = vjp(cot.astype(y.dtype))
+        gmask = b_active
+        grads = jax.tree_util.tree_map(
+            lambda g, d: g + jnp.where(gmask, d.astype(g.dtype), 0),
+            grads, dp)
+        if head is not None:
+            hmask = is_last & f_active
+            head = jax.tree_util.tree_map(
+                lambda g, d: g + jnp.where(hmask, d.astype(g.dtype), 0),
+                head, hgrads)
+        loss = loss + jnp.where(is_last & f_active, loss_mb, 0.0)
+        # stage-0 input cotangents (for the embedding backward outside)
+        dx0_buf = lax.cond(
+            (s == 0) & b_active,
+            lambda buf: lax.dynamic_update_index_in_dim(
+                buf, dx.astype(dtype), jnp.clip(mb, 0, m - 1), 0),
+            lambda buf: buf, dx0_buf)
+        # ---------------- message hops
+        act_out = lax.ppermute(y, axis_name, fwd_perm)
+        cot_out = lax.ppermute(dx, axis_name, bwd_perm)
+        return (act_out, cot_out, stash, grads, head, loss,
+                dx0_buf), None
+
+    carry0 = (act0, cot0, stash0, grads0, head0,
+              _varying(jnp.zeros((), grad_dtype)), dx0_buf0)
+    carry, _ = lax.scan(tick, carry0, jnp.arange(t_total))
+    _, _, _, grads, head, loss, dx0_buf = carry
+    # loss and head grads live on the last stage; dx0 on stage 0 —
+    # psum replicates them everywhere (masked elsewhere-zero)
+    loss = lax.psum(jnp.where(s == n - 1, loss, 0.0), axis_name)
+    if head is not None:
+        head = jax.tree_util.tree_map(
+            lambda g: lax.psum(jnp.where(s == n - 1, g,
+                                         jnp.zeros_like(g)), axis_name),
+            head)
+    dx0 = lax.psum(jnp.where(s == 0, dx0_buf, jnp.zeros_like(dx0_buf)),
+                   axis_name)
+    grads = jax.tree_util.tree_map(lambda g: g[None], grads)
+    return loss, grads, head, dx0

@@ -1,0 +1,165 @@
+"""Compiled 1F1B pipeline (parallel/pipeline_1f1b.py).
+
+Checks, per VERDICT round-1 item 6:
+  1. numerics — loss and ALL gradients (stage params, head params,
+     stage-0 input cotangents) match plain jax autodiff of the
+     sequential composition;
+  2. schedule equivalence — the compiled timeline validates under
+     pp_schedule's dependency simulator and its peak-activation count
+     is bounded by 2N-1 independent of M (vs M for GPipe/F-then-B);
+  3. the bound beats GPipe's for M > 2(N-1)+1.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from paddle_tpu.parallel.pipeline_1f1b import (compiled_1f1b_schedule,
+                                               pipeline_train_1f1b)
+from paddle_tpu.parallel.pipeline import stack_stage_params
+from paddle_tpu.parallel.pp_schedule import schedule_fthenb
+
+N_STAGES = 4
+HID = 8
+
+
+def _stage_fn(params, x):
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + x
+
+
+def _head(y, wh, targets_mb):
+    # mean-square head with a parameter, per microbatch
+    pred = y @ wh
+    return jnp.mean((pred - targets_mb) ** 2)
+
+
+def _make(m, seed=0):
+    rng = np.random.RandomState(seed)
+    stages = [{"w1": jnp.asarray(rng.randn(HID, HID) * 0.3,
+                                 jnp.float32),
+               "b1": jnp.asarray(rng.randn(HID) * 0.1, jnp.float32),
+               "w2": jnp.asarray(rng.randn(HID, HID) * 0.3,
+                                 jnp.float32)}
+              for _ in range(N_STAGES)]
+    wh = jnp.asarray(rng.randn(HID, 3) * 0.4, jnp.float32)
+    mb = jnp.asarray(rng.randn(m, 2, HID), jnp.float32)
+    tgt = jnp.asarray(rng.randn(m, 2, 3), jnp.float32)
+    return stages, wh, mb, tgt
+
+
+def _oracle(stages, wh, mb, tgt):
+    """Plain autodiff of the sequential composition, summed over M."""
+    def total_loss(stages, wh, x0):
+        def per_mb(x, t):
+            for p in stages:
+                x = _stage_fn(p, x)
+            return _head(x, wh, t)
+        return sum(per_mb(mb[i], tgt[i]) for i in range(mb.shape[0]))
+
+    loss, grads = jax.value_and_grad(total_loss, argnums=(0, 1))(
+        stages, wh, mb)
+    # input cotangents at stage 0
+    def loss_of_x(x0):
+        def per(x, t):
+            for p in stages:
+                x = _stage_fn(p, x)
+            return _head(x, wh, t)
+        return sum(per(x0[i], tgt[i]) for i in range(mb.shape[0]))
+    dx0 = jax.grad(loss_of_x)(mb)
+    return loss, grads[0], grads[1], dx0
+
+
+@pytest.mark.parametrize("m", [4, 8])
+def test_1f1b_matches_autodiff_oracle(m):
+    stages, wh, mb, tgt = _make(m)
+    devices = jax.devices()[:N_STAGES]
+    mesh = Mesh(np.asarray(devices), ("pp",))
+    stacked = stack_stage_params(stages)
+
+    def body(stacked, mb, tgt, wh):
+        def last_grad(y, hp, mb_idx):
+            t = tgt[mb_idx]        # replicated labels by microbatch id
+            def head_loss(wh_, y_):
+                return _head(y_, wh_, t)
+            (loss, (gwh, gy)) = jax.value_and_grad(
+                head_loss, argnums=(0, 1))(hp["wh"], y)
+            return loss, gy, {"wh": gwh}
+        return pipeline_train_1f1b(_stage_fn, stacked, mb, last_grad,
+                                   head_params={"wh": wh})
+
+    specs = jax.tree_util.tree_map(lambda _: P("pp"), stacked)
+    loss, grads, head, dx0 = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(specs, P(None), P(None), P(None)),
+        out_specs=(P(), specs, P(), P(None))))(stacked, mb, tgt, wh)
+
+    ref_loss, ref_sg, ref_wh, ref_dx0 = _oracle(stages, wh, mb, tgt)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(head["wh"]),
+                               np.asarray(ref_wh), rtol=1e-4,
+                               atol=1e-5)
+    for i in range(N_STAGES):
+        got = jax.tree_util.tree_map(lambda g: np.asarray(g[i]), grads)
+        for name in ("w1", "b1", "w2"):
+            np.testing.assert_allclose(
+                got[name], np.asarray(ref_sg[i][name]),
+                rtol=1e-4, atol=1e-5, err_msg=f"stage{i}.{name}")
+    np.testing.assert_allclose(np.asarray(dx0), np.asarray(ref_dx0),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_compiled_schedule_validates_and_bounds_memory():
+    for n, m in [(4, 8), (4, 32), (2, 4), (8, 16)]:
+        sched = compiled_1f1b_schedule(n, m)
+        makespan, bubble = sched.simulate()   # raises on bad deps
+        assert makespan > 0
+        # the liveness bound: 2N-1 independent of M
+        assert sched.peak_activations() == min(m, 2 * (n - 1) + 1)
+        assert schedule_fthenb(n, m).peak_activations() == m
+
+
+def test_memory_bound_beats_gpipe_for_deep_m():
+    n = 4
+    gpipe = schedule_fthenb(n, 32).peak_activations()
+    ours = compiled_1f1b_schedule(n, 32).peak_activations()
+    assert ours == 7 and gpipe == 32
+
+
+def test_gpt_hybrid_1f1b_matches_gpipe():
+    """The hybrid engine's pp_schedule='1f1b' path trains the same
+    model as the gpipe path: identical loss on step 1 and matching
+    updated parameters."""
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.models.gpt_hybrid import ParallelConfig, setup
+
+    cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=4,
+                    num_heads=2, max_seq_len=16)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, 64, (4, 16)))
+
+    results = {}
+    for sched in ("gpipe", "1f1b"):
+        pcfg = ParallelConfig(dp=1, pp=4, tp=1, microbatches=4,
+                              remat=True, fused_ce=False,
+                              pp_schedule=sched,
+                              param_dtype=jnp.float32,
+                              compute_dtype=jnp.float32)
+        mesh, params, opt, step = setup(cfg, pcfg, seed=0,
+                                        devices=jax.devices()[:4])
+        with mesh:
+            new_params, _, loss = step(params, opt, (ids, ids))
+        results[sched] = (float(loss), new_params)
+
+    l_g, p_g = results["gpipe"]
+    l_f, p_f = results["1f1b"]
+    np.testing.assert_allclose(l_f, l_g, rtol=1e-5)
+    flat_g = jax.tree_util.tree_leaves(p_g)
+    flat_f = jax.tree_util.tree_leaves(p_f)
+    for a, b in zip(flat_g, flat_f):
+        np.testing.assert_allclose(np.asarray(b).reshape(-1),
+                                   np.asarray(a).reshape(-1),
+                                   rtol=2e-4, atol=2e-5)
