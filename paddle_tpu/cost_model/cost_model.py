@@ -108,15 +108,23 @@ class CostModel:
         return (time.perf_counter() - t0) / steps
 
     # ------------------------------------------------ model-level helper
+    @staticmethod
+    def train_flops(n_params: float, layers: int, hidden: int, seq: int,
+                    batch_tokens: float) -> float:
+        """fwd+bwd transformer FLOPs: 6/param/token + the attention
+        quadratic term — the single home of this formula (used by
+        transformer_step_cost and the distributed planner)."""
+        return (6.0 * n_params + 12.0 * layers * hidden * seq) \
+            * batch_tokens
+
     def transformer_step_cost(self, n_params: float, batch_tokens: float,
                               hidden: int, layers: int, seq: int,
                               n_chips: int = 1, dp: int = 1, tp: int = 1,
                               dtype_bytes: int = 2) -> OpCost:
-        """End-to-end train-step estimate (fwd+bwd = 6 FLOPs/param/token
-        + attention quadratic term), with DP grad all_reduce and TP
+        """End-to-end train-step estimate with DP grad all_reduce and TP
         activation collectives — the planner's objective function."""
-        flops = (6.0 * n_params + 12.0 * layers * hidden * seq) \
-            * batch_tokens
+        flops = self.train_flops(n_params, layers, hidden, seq,
+                                 batch_tokens)
         cost = OpCost(flops=flops,
                       bytes_accessed=dtype_bytes * n_params * 3)
         cost = self._finish(cost)

@@ -21,8 +21,12 @@ class Candidate:
     pp: int
     tp: int
     microbatches: int = 1
+    sp: bool = False
+    zero: int = 0
+    remat: bool = True
     time_s: Optional[float] = None
     error: Optional[str] = None
+    plan: Optional[object] = None   # full PlanCandidate when planner-guided
 
 
 def _divisors(n):
@@ -88,3 +92,21 @@ def tune(run_fn: Callable[[Candidate], float],
     if best is None:
         raise RuntimeError("auto_tuner: no feasible candidate")
     return best
+
+
+def planner_guided_candidates(model_spec, n_chips: int,
+                              global_batch: int, chip: str = "v5e",
+                              top_k: int = 8) -> List[Candidate]:
+    """Analytic-first search (the reference planner_v2 -> auto-tuner
+    handoff): rank the full (dp, tp, pp, sp, zero, remat, microbatch)
+    space with the calibrated cost model (distributed/planner.py), then
+    hand only the top_k to `tune` for real measurement — replacing the
+    blind grid with a model-pruned shortlist."""
+    from paddle_tpu.distributed.planner import Planner
+
+    plans = Planner(chip).plan(model_spec, n_chips, global_batch,
+                               top_k=top_k)
+    return [Candidate(dp=p.dp, pp=p.pp, tp=p.tp,
+                      microbatches=p.microbatches, sp=p.sp,
+                      zero=p.zero, remat=p.remat, plan=p)
+            for p in plans]

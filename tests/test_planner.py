@@ -1,0 +1,136 @@
+"""Parallel-plan search (distributed/planner.py) — reference:
+auto_parallel/static/planner_v2.py over the static_op_benchmark table.
+
+Acceptance (VERDICT round-1 item 7): the planner's cost model is
+calibrated against the repo's own recorded v5e bench points, reproduces
+the hand-found configs for the BASELINE workloads, and its top-1 plan
+executes on the 8-virtual-device mesh.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.distributed.planner import ModelSpec, Planner, PlanCandidate
+
+GPT13 = ModelSpec.gpt(1.3e9, layers=24, hidden=2048, heads=16,
+                      seq=1024, vocab=50257)
+LLAMA7 = ModelSpec.gpt(6.7e9, layers=32, hidden=4096, heads=32,
+                       seq=2048, vocab=32000)
+
+
+def test_calibration_against_recorded_bench():
+    """Single-chip GPT-1.3B: the calibrated model must land near the
+    driver-recorded 14.57k tok/s/chip, keep B4 feasible and reject B8
+    (the measured OOM boundary), and force remat on."""
+    p = Planner("v5e")
+    plans = p.plan(GPT13, 1, global_batch=4)
+    best = plans[0]
+    pred = p.throughput(best, GPT13, 4, 1)
+    assert 0.7 * 14_570 <= pred <= 1.3 * 14_570, pred
+    assert best.remat            # noremat cannot fit 16G at 1.3B
+    with pytest.raises(RuntimeError):
+        p.plan(GPT13, 1, global_batch=8)
+
+
+def test_1p3b_8chip_reproduces_hand_config():
+    """BASELINE workload 'GPT-3 1.3B DP+sharding-1': at a real global
+    batch the planner's top plan is pure data parallel with optimizer
+    sharding."""
+    p = Planner("v5e")
+    best = p.plan(GPT13, 8, global_batch=256)[0]
+    assert (best.dp, best.tp, best.pp) == (8, 1, 1), best.short()
+    assert best.zero >= 1, best.short()
+
+
+def test_7b_8chip_needs_model_parallelism():
+    """BASELINE workload 'Llama-2 7B TP4xPP2xsharding-3': 7B does not
+    fit 16G chips data-parallel-only without ZeRO-3; the planner must
+    pick model-parallel sharding, and the hand config's tp>=2 x pp>=2
+    family must rank in the top 5."""
+    p = Planner("v5e")
+    plans = p.plan(LLAMA7, 8, global_batch=32)
+    best = plans[0]
+    assert best.tp > 1 or best.pp > 1 or best.zero == 3, best.short()
+    assert any(c.tp >= 2 and c.pp >= 2 for c in plans), \
+        [c.short() for c in plans]
+    # pure dp8 without ZeRO-3 is memory-infeasible for 6.7B on 16G
+    infeasible = [c for c in plans
+                  if (c.dp, c.tp, c.pp, c.zero) == (8, 1, 1, 0)]
+    assert not infeasible
+
+
+def test_7b_engine_capable_reproduces_tp4_pp2():
+    """Constrained to the ZeRO stages the compiled engine executes
+    (<=1), the planner's TOP-1 for 7B on 8 v5e chips is the BASELINE
+    hand config itself: TP4 x PP2 (+sp)."""
+    p = Planner("v5e", zero_stages=(0, 1))
+    best = p.plan(LLAMA7, 8, global_batch=32)[0]
+    assert (best.tp, best.pp) == (4, 2), best.short()
+    assert best.sp
+
+
+def test_larger_meshes_plan():
+    p = Planner("v5p")
+    for n in (16, 32):
+        plans = p.plan(LLAMA7, n, global_batch=256)
+        best = plans[0]
+        assert best.dp * best.tp * best.pp == n
+        # 95G chips: dp-major with optimizer sharding wins at scale
+        assert best.dp >= n // 4, best.short()
+
+
+def test_infeasible_raises():
+    p = Planner("v5e")
+    with pytest.raises(RuntimeError, match="no feasible"):
+        p.plan(ModelSpec.gpt(70e9, 80, 8192, 64, 4096, 32000), 1, 8)
+
+
+def test_breakdown_and_tie_break():
+    p = Planner("v5e")
+    plans = p.plan(GPT13, 8, global_batch=256)
+    for c in plans:
+        assert c.est_step_s > 0 and "compute" in c.breakdown
+    # among near-equal-time dp8 plans, lower-memory zero stages first
+    dp8 = [c for c in plans if (c.dp, c.tp, c.pp) == (8, 1, 1)]
+    for a, b in zip(dp8, dp8[1:]):
+        assert (round(a.est_step_s, 3), a.est_mem_bytes) <= \
+               (round(b.est_step_s, 3), b.est_mem_bytes)
+
+
+def test_top1_validates_via_dryrun():
+    """The planner's chosen config for a small model executes one real
+    hybrid train step on the 8-device mesh (the reference planner's
+    'plan must run' check)."""
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.models.gpt_hybrid import ParallelConfig, setup
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=4,
+                    num_heads=4, max_seq_len=16)
+    spec = ModelSpec.from_config(cfg)
+    # zero_stages limited to what the compiled hybrid engine executes
+    planner = Planner("v5e", zero_stages=(0, 1))
+    best = planner.plan(spec, 8, global_batch=16)[0]
+    pcfg = ParallelConfig(
+        dp=best.dp, pp=best.pp, tp=best.tp, sp=best.sp,
+        zero1=best.zero >= 1,
+        microbatches=max(best.microbatches, 1),
+        remat=best.remat,
+        pp_schedule="1f1b" if best.pp > 1 else "gpipe",
+        param_dtype=jnp.float32, compute_dtype=jnp.float32)
+    mesh, params, opt, step = setup(cfg, pcfg, seed=0,
+                                    devices=jax.devices()[:8])
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 128, (16, 16)))
+    with mesh:
+        _, _, loss = step(params, opt, (ids, ids))
+    assert np.isfinite(float(loss))
+
+
+def test_model_spec_from_config():
+    from paddle_tpu.models.gpt import GPTConfig
+    cfg = GPTConfig(vocab_size=50257, hidden_size=2048, num_layers=24,
+                    num_heads=16, max_seq_len=1024)
+    spec = ModelSpec.from_config(cfg)
+    # parameter-count formula lands near the real 1.3B
+    assert 1.1e9 < spec.n_params < 1.6e9, spec.n_params
