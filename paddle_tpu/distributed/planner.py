@@ -183,7 +183,8 @@ class Planner:
                     "all_gather", p_bytes, c.dp).time_s
 
         # ---- PP: activation hops (fwd + cotangent bwd per microbatch
-        # per stage boundary) + the compiled-1F1B ramp bubble
+        # per stage boundary) + the compiled-1F1B ramp bubble.
+        # breakdown holds SECONDS only and sums exactly to est_step_s.
         if c.pp > 1:
             hop_bytes = 2.0 * mb_tokens * m.hidden
             bd["pp_comm"] = 2 * c.microbatches * self.cm.collective_cost(
@@ -191,7 +192,7 @@ class Planner:
         step = sum(bd.values())
         if c.pp > 1:
             bubble = 2.0 * (c.pp - 1) / max(c.microbatches, 1)
-            bd["pp_bubble"] = step * bubble / (1 + bubble)
+            bd["pp_bubble"] = step * bubble
             step *= (1 + bubble)
 
         # ---- memory (calibrated against the v5e bench reality:
@@ -217,7 +218,6 @@ class Planner:
         if c.pp > 1:
             act *= min(2 * c.pp - 1, c.microbatches)   # 1F1B in-flight
         mem += act
-        bd["act_bytes"] = act
 
         c.est_step_s = step
         c.est_mem_bytes = mem

@@ -116,6 +116,33 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
     return _pad(x, pad, mode, value, data_format)
 
 
+def _cubic_matrix(n_in, n_out, align_corners, a=-0.75):
+    """[n_out, n_in] cubic-convolution resize weights (Keys kernel,
+    a=-0.75 — the reference bicubic_interp kernel's constant), edge
+    taps clamped (replicate)."""
+    import numpy as _np
+
+    def kern(d):
+        d = _np.abs(d)
+        return _np.where(
+            d <= 1, (a + 2) * d ** 3 - (a + 3) * d ** 2 + 1,
+            _np.where(d < 2,
+                      a * d ** 3 - 5 * a * d ** 2 + 8 * a * d - 4 * a,
+                      0.0))
+
+    i = _np.arange(n_out)
+    if align_corners and n_out > 1:
+        s = i * (n_in - 1) / (n_out - 1)
+    else:
+        s = (i + 0.5) * n_in / n_out - 0.5
+    f0 = _np.floor(s).astype(int)
+    w = _np.zeros((n_out, n_in), _np.float32)
+    for tap in (-1, 0, 1, 2):
+        idx = _np.clip(f0 + tap, 0, n_in - 1)
+        _np.add.at(w, (i, idx), kern(s - (f0 + tap)).astype(_np.float32))
+    return jnp.asarray(w)
+
+
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 align_corners=False, align_mode=0, data_format="NCHW",
                 name=None):
@@ -144,6 +171,24 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
             new_shape = (a.shape[0],) + tuple(out_size) + (a.shape[-1],)
         else:
             new_shape = a.shape[:2] + tuple(out_size)
+        if jmode == "cubic":
+            # paddle/torch bicubic uses the Keys kernel with a=-0.75
+            # (jax.image.resize's cubic is a=-0.5 — off by up to ~0.2
+            # per pixel); separable per-axis weight MATRICES keep the
+            # resize as two MXU matmuls
+            offset = 1 if data_format.endswith("C") else 2
+            out = a
+            for d in range(nd):
+                axis = offset + d
+                w = _cubic_matrix(spatial[d], out_size[d],
+                                  align_corners)
+                moved = jnp.moveaxis(out, axis, -1)
+                # HIGHEST: the default matmul precision truncates to
+                # bf16 on TPU (~3e-3 error vs the exact cubic kernel)
+                moved = jnp.tensordot(moved, w, axes=([-1], [1]),
+                                      precision=jax.lax.Precision.HIGHEST)
+                out = jnp.moveaxis(moved, -1, axis)
+            return out.astype(a.dtype)
         if jmode == "nearest":
             # paddle/torch nearest = src_idx = floor(dst * in/out)
             # (jax.image.resize rounds at pixel centers — different

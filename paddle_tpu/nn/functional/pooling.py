@@ -48,6 +48,56 @@ def _pads(padding, n, channels_last, ceil_mode, shape, ksize, stride):
     return [(0, 0), (0, 0)] + pairs
 
 
+def _max_pool_with_mask(name, x, n, kernel_size, stride, padding,
+                        ceil_mode, channels_last):
+    """Max pool returning (out, flat argmax indices over the pooled
+    spatial dims) — the reference max_pool*_with_index kernels' mask.
+
+    The VALUES take the ordinary differentiable max reduce_window (so
+    training through the pooled output works); the INDICES come from a
+    separate non-differentiable variadic reduce_window that reduces
+    (value, flat_index) pairs with a lexicographic combine (smallest
+    index wins ties, the torch/reference convention). The variadic
+    reduce_window has no autodiff transpose rule, which is fine here —
+    indices carry no gradient."""
+    ksize = _tuple(kernel_size, n)
+    stride_t = _tuple(stride if stride is not None else kernel_size, n)
+    out = _pool(name, x, n, "max", kernel_size, stride, padding,
+                ceil_mode, channels_last)
+
+    def f_mask(a):
+        if channels_last:
+            a = jnp.moveaxis(a, -1, 1)
+        sp = a.shape[2:]
+        flat = np.prod(sp)
+        idx = jnp.arange(flat, dtype=jnp.int32).reshape(sp)
+        idx = jnp.broadcast_to(idx, a.shape)
+        dims = (1, 1) + ksize
+        strides = (1, 1) + stride_t
+        pads = _pads(padding, n, False, ceil_mode, a.shape, ksize,
+                     stride_t)
+
+        def combine(p, q):
+            pv, pi = p
+            qv, qi = q
+            take_q = (qv > pv) | ((qv == pv) & (qi < pi))
+            return (jnp.where(take_q, qv, pv),
+                    jnp.where(take_q, qi, pi))
+
+        init_v = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) \
+            else jnp.iinfo(a.dtype).min
+        _, mask = jax.lax.reduce_window(
+            (a, idx), (jnp.asarray(init_v, a.dtype),
+                       jnp.asarray(flat, jnp.int32)),
+            combine, dims, strides, pads)
+        if channels_last:
+            mask = jnp.moveaxis(mask, 1, -1)
+        return mask.astype(jnp.int64)
+
+    mask = run_op(name + "_mask", f_mask, x, differentiable=False)
+    return out, mask
+
+
 def _pool(name, x, n, kind, kernel_size, stride, padding, ceil_mode,
           channels_last, exclusive=True, divisor_override=None):
     ksize = _tuple(kernel_size, n)
@@ -76,20 +126,30 @@ def _pool(name, x, n, kind, kernel_size, stride, padding, ceil_mode,
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
-    out = _pool("max_pool1d", x, 1, "max", kernel_size, stride, padding,
-                ceil_mode, data_format.endswith("C") and data_format != "NCL"
-                and data_format != "NCW")
-    return out
+    cl = data_format.endswith("C") and data_format not in ("NCL", "NCW")
+    if return_mask:
+        return _max_pool_with_mask("max_pool1d", x, 1, kernel_size,
+                                   stride, padding, ceil_mode, cl)
+    return _pool("max_pool1d", x, 1, "max", kernel_size, stride, padding,
+                 ceil_mode, cl)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask("max_pool2d", x, 2, kernel_size,
+                                   stride, padding, ceil_mode,
+                                   data_format == "NHWC")
     return _pool("max_pool2d", x, 2, "max", kernel_size, stride, padding,
                  ceil_mode, data_format == "NHWC")
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask("max_pool3d", x, 3, kernel_size,
+                                   stride, padding, ceil_mode,
+                                   data_format == "NDHWC")
     return _pool("max_pool3d", x, 3, "max", kernel_size, stride, padding,
                  ceil_mode, data_format == "NDHWC")
 

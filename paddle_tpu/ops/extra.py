@@ -213,7 +213,7 @@ def tensor_unfold(x, axis, size, step):
 
 def view_dtype(x, dtype):
     from paddle_tpu.core import dtype as dtype_mod
-    jd = dtype_mod.to_jax(dtype)
+    jd = dtype_mod.convert_dtype(dtype)
     return run_op("view_dtype",
                   lambda a: lax.bitcast_convert_type(a, jd), _t(x))
 
@@ -471,22 +471,12 @@ def gather_tree(ids, parents):
 
 def top_p_sampling(x, ps, threshold=None, seed=None):
     """Nucleus sampling (reference top_p_sampling op). x: [B, V] probs.
-    Returns (sampled values [B, 1], sampled ids [B, 1])."""
-    key = gen_mod.next_key() if seed is None else jax.random.PRNGKey(seed)
-
-    def f(probs, p):
-        order = jnp.argsort(-probs, -1)
-        sorted_p = jnp.take_along_axis(probs, order, -1)
-        cum = jnp.cumsum(sorted_p, -1)
-        keep = cum - sorted_p <= p[:, None]
-        keep = keep.at[:, 0].set(True)
-        filt = jnp.where(keep, sorted_p, 0.0)
-        filt = filt / jnp.sum(filt, -1, keepdims=True)
-        choice = jax.random.categorical(key, jnp.log(filt + 1e-30), -1)
-        ids = jnp.take_along_axis(order, choice[:, None], -1)
-        vals = jnp.take_along_axis(probs, ids, -1)
-        return vals, ids.astype(jnp.int64)
-    return run_op("top_p_sampling", f, _t(x), _t(ps))
+    Returns (sampled values [B, 1], sampled ids [B, 1]). Delegates to
+    the single implementation in ops.search (one home for the
+    probs-contract semantics)."""
+    from paddle_tpu.ops.search import top_p_sampling as _impl
+    return _impl(_t(x), _t(ps), threshold=threshold,
+                 seed=-1 if seed is None else int(seed))
 
 
 # ---------------------------------------------------------------------

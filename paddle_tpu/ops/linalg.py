@@ -257,17 +257,20 @@ def lu(x, pivot=True, get_infos=False, name=None):
 
 def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
     def f(lu_, piv):
-        n = lu_.shape[-2]
-        l = jnp.tril(lu_, -1) + jnp.eye(n, lu_.shape[-1], dtype=lu_.dtype)
-        u = jnp.triu(lu_)
-        perm = jnp.arange(n)
+        m, n = lu_.shape[-2], lu_.shape[-1]
+        k = min(m, n)
+        # torch/reference lu_unpack shapes: L [m, k], U [k, n]
+        l = (jnp.tril(lu_, -1)
+             + jnp.eye(m, n, dtype=lu_.dtype))[..., :m, :k]
+        u = jnp.triu(lu_)[..., :k, :n]
+        perm = jnp.arange(m)
         def body(i, p):
             j = piv[i] - 1
             pi, pj = p[i], p[j]
             return p.at[i].set(pj).at[j].set(pi)
         perm = jax.lax.fori_loop(0, piv.shape[-1], body, perm)
-        pmat = jax.nn.one_hot(perm, n, dtype=lu_.dtype).T
-        return pmat, l[..., :n, :min(n, lu_.shape[-1])], u
+        pmat = jax.nn.one_hot(perm, m, dtype=lu_.dtype).T
+        return pmat, l, u
     return run_op("lu_unpack", f, x, y)
 
 

@@ -260,8 +260,12 @@ def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1, k=0,
     key = jax.random.PRNGKey(seed) if seed >= 0 else \
         default_generator().next_key()
 
-    def f(logits, p):
-        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    def f(probs_in, p):
+        # x is a probability distribution per row (reference
+        # tensor/search.py top_p_sampling contract — NOT logits);
+        # normalize defensively so un-normalized input still works
+        probs = probs_in.astype(jnp.float32)
+        probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
         order = jnp.argsort(-probs, axis=-1)
         sorted_p = jnp.take_along_axis(probs, order, axis=-1)
         cum = jnp.cumsum(sorted_p, axis=-1)
@@ -274,7 +278,7 @@ def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1, k=0,
             jnp.maximum(masked, 1e-38)), axis=-1)
         ids = jnp.take_along_axis(order, draw[:, None], axis=-1)
         scores = jnp.take_along_axis(probs, ids, axis=-1)
-        return scores.astype(logits.dtype), ids.astype(jnp.int64)
+        return scores.astype(probs_in.dtype), ids.astype(jnp.int64)
 
     out = run_op("top_p_sampling", f, x, ps, n_outputs=2,
                  differentiable=False)

@@ -1,0 +1,267 @@
+"""Fifth op-oracle sweep tranche: conv/pool dimensional variants,
+interpolate modes, signal ops (stft/frame/overlap_add), remaining
+linalg (householder_product/ormqr), ctc, and alias schemas."""
+import numpy as np
+import pytest
+import scipy.signal
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+rng = np.random.RandomState(17)
+
+
+def T(shape, lo=-2.0, hi=2.0):
+    return rng.uniform(lo, hi, shape).astype(np.float32)
+
+
+def _cmp(got, ref, rtol=1e-4, atol=1e-5):
+    np.testing.assert_allclose(np.asarray(got.numpy()), ref,
+                               rtol=rtol, atol=atol)
+
+
+def test_conv_transpose_variants():
+    x1 = T((2, 3, 8))
+    w1 = T((3, 4, 3)) * 0.2
+    _cmp(F.conv1d_transpose(paddle.to_tensor(x1), paddle.to_tensor(w1),
+                            stride=2, padding=1),
+         tF.conv_transpose1d(torch.tensor(x1), torch.tensor(w1),
+                             stride=2, padding=1).numpy())
+    x3 = T((1, 2, 4, 4, 4))
+    w3 = T((2, 3, 3, 3, 3)) * 0.2
+    _cmp(F.conv3d_transpose(paddle.to_tensor(x3), paddle.to_tensor(w3),
+                            stride=2),
+         tF.conv_transpose3d(torch.tensor(x3), torch.tensor(w3),
+                             stride=2).numpy())
+
+
+def test_pool_dimensional_variants():
+    x1 = T((2, 3, 10))
+    _cmp(F.avg_pool1d(paddle.to_tensor(x1), 2),
+         tF.avg_pool1d(torch.tensor(x1), 2).numpy())
+    _cmp(F.max_pool1d(paddle.to_tensor(x1), 2),
+         tF.max_pool1d(torch.tensor(x1), 2).numpy())
+    _cmp(F.adaptive_avg_pool1d(paddle.to_tensor(x1), 5),
+         tF.adaptive_avg_pool1d(torch.tensor(x1), 5).numpy())
+    _cmp(F.adaptive_max_pool1d(paddle.to_tensor(x1), 5),
+         tF.adaptive_max_pool1d(torch.tensor(x1), 5).numpy())
+    x3 = T((1, 2, 6, 6, 6))
+    _cmp(F.avg_pool3d(paddle.to_tensor(x3), 2),
+         tF.avg_pool3d(torch.tensor(x3), 2).numpy())
+    _cmp(F.max_pool3d(paddle.to_tensor(x3), 2),
+         tF.max_pool3d(torch.tensor(x3), 2).numpy())
+    _cmp(F.adaptive_avg_pool3d(paddle.to_tensor(x3), 3),
+         tF.adaptive_avg_pool3d(torch.tensor(x3), 3).numpy())
+    _cmp(F.adaptive_max_pool3d(paddle.to_tensor(x3), 3),
+         tF.adaptive_max_pool3d(torch.tensor(x3), 3).numpy())
+    _cmp(F.lp_pool1d(paddle.to_tensor(x1), 2.0, 2),
+         tF.lp_pool1d(torch.tensor(x1), 2.0, 2).numpy())
+    x2 = T((2, 3, 6, 6))
+    _cmp(F.lp_pool2d(paddle.to_tensor(x2), 2.0, 2),
+         tF.lp_pool2d(torch.tensor(x2), 2.0, 2).numpy())
+
+
+def test_max_pool_with_index_and_unpool():
+    x = T((1, 2, 6, 6))
+    out, idx = F.max_pool2d(paddle.to_tensor(x), 2,
+                            return_mask=True)
+    t_out, t_idx = tF.max_pool2d(torch.tensor(x), 2,
+                                 return_indices=True)
+    _cmp(out, t_out.numpy())
+    np.testing.assert_array_equal(idx.numpy(), t_idx.numpy())
+    un = F.max_unpool2d(out, idx, 2)
+    t_un = tF.max_unpool2d(t_out, t_idx, 2)
+    _cmp(un, t_un.numpy())
+    # the pooled VALUES stay differentiable with return_mask=True
+    xg = paddle.to_tensor(x, stop_gradient=False)
+    out_g, _ = F.max_pool2d(xg, 2, return_mask=True)
+    out_g.sum().backward()
+    xt = torch.tensor(x, requires_grad=True)
+    t_o, _ = tF.max_pool2d(xt, 2, return_indices=True)
+    t_o.sum().backward()
+    np.testing.assert_allclose(xg.grad.numpy(), xt.grad.numpy())
+
+
+def test_interpolate_modes_cover_interp_schemas():
+    """F.interpolate modes are the public surface of the
+    {bilinear,nearest,bicubic,linear,trilinear}_interp kernels."""
+    x2 = T((1, 2, 5, 5))
+    for mode in ("nearest", "bilinear", "bicubic"):
+        kw = {} if mode == "nearest" else {"align_corners": False}
+        _cmp(F.interpolate(paddle.to_tensor(x2), size=[8, 8],
+                           mode=mode, **kw),
+             tF.interpolate(torch.tensor(x2), size=(8, 8), mode=mode,
+                            **kw).numpy(), rtol=1e-3, atol=1e-4)
+    x1 = T((1, 2, 6))
+    _cmp(F.interpolate(paddle.to_tensor(x1), size=[9], mode="linear",
+                       align_corners=False),
+         tF.interpolate(torch.tensor(x1), size=9, mode="linear",
+                        align_corners=False).numpy(), rtol=1e-4)
+    x3 = T((1, 1, 4, 4, 4))
+    _cmp(F.interpolate(paddle.to_tensor(x3), size=[6, 6, 6],
+                       mode="trilinear", align_corners=False),
+         tF.interpolate(torch.tensor(x3), size=(6, 6, 6),
+                        mode="trilinear",
+                        align_corners=False).numpy(), rtol=1e-4)
+    # upsample is the same kernel family
+    _cmp(F.upsample(paddle.to_tensor(x2), scale_factor=2,
+                    mode="nearest"),
+         tF.interpolate(torch.tensor(x2), scale_factor=2,
+                        mode="nearest").numpy())
+
+
+def test_norm_layers_direct():
+    x = T((4, 6))
+    g, b = T((6,)), T((6,))
+    _cmp(F.layer_norm(paddle.to_tensor(x), normalized_shape=[6],
+                      weight=paddle.to_tensor(g),
+                      bias=paddle.to_tensor(b)),
+         tF.layer_norm(torch.tensor(x), [6], torch.tensor(g),
+                       torch.tensor(b)).numpy(), rtol=1e-4)
+    # rms_norm schema == incubate fused_rms_norm capability
+    w = T((6,), 0.5, 1.5)
+    got = paddle.incubate.nn.functional.fused_rms_norm(
+        paddle.to_tensor(x), paddle.to_tensor(w), None, 1e-6, 1)[0]
+    ref = x / np.sqrt((x * x).mean(-1, keepdims=True) + 1e-6) * w
+    _cmp(got, ref)
+
+
+def test_ctc_loss_vs_torch():
+    tdim, b, c = 6, 2, 5
+    logits = T((tdim, b, c))
+    labels = rng.randint(1, c, (b, 3)).astype(np.int32)
+    in_len = np.full((b,), tdim, np.int64)
+    lbl_len = np.full((b,), 3, np.int64)
+    got = F.ctc_loss(paddle.to_tensor(logits),
+                     paddle.to_tensor(labels),
+                     paddle.to_tensor(in_len),
+                     paddle.to_tensor(lbl_len),
+                     blank=0, reduction="none")
+    ref = tF.ctc_loss(torch.tensor(logits).log_softmax(-1),
+                      torch.tensor(labels.astype(np.int64)),
+                      torch.tensor(in_len), torch.tensor(lbl_len),
+                      blank=0, reduction="none")
+    np.testing.assert_allclose(got.numpy().reshape(-1), ref.numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_margin_cross_entropy():
+    # cosine-margin loss: inputs are cosines, domain [-1, 1]
+    logits = T((4, 6), lo=-0.9, hi=0.9)
+    label = rng.randint(0, 6, (4,)).astype(np.int64)
+    loss, softmax = F.margin_cross_entropy(
+        paddle.to_tensor(logits), paddle.to_tensor(label),
+        margin1=1.0, margin2=0.0, margin3=0.0, scale=1.0,
+        return_softmax=True)
+    # with neutral margins this is plain softmax CE
+    import scipy.special as sps
+    p = sps.softmax(logits, -1)
+    ref = -np.log(p[np.arange(4), label])
+    np.testing.assert_allclose(loss.numpy().reshape(-1), ref,
+                               rtol=1e-4)
+
+
+def test_signal_ops_vs_scipy():
+    x = T((2, 64))
+    fr = paddle.signal.frame(paddle.to_tensor(x), frame_length=16,
+                             hop_length=8)
+    # reference layout: frames stacked on a new trailing axis
+    ref = np.stack([x[:, i * 8: i * 8 + 16]
+                    for i in range(7)], -1)
+    np.testing.assert_allclose(fr.numpy(), ref, rtol=1e-6)
+    back = paddle.signal.overlap_add(fr, hop_length=8)
+    win = np.zeros(64, np.float32)
+    acc = np.zeros((2, 64), np.float32)
+    for i in range(7):
+        acc[:, i * 8: i * 8 + 16] += ref[..., i]
+    np.testing.assert_allclose(back.numpy(), acc, rtol=1e-5)
+    # stft vs scipy
+    st = paddle.signal.stft(paddle.to_tensor(x), n_fft=16,
+                            hop_length=8, center=False,
+                            onesided=True).numpy()
+    f_, t_, ref_st = scipy.signal.stft(
+        x, nperseg=16, noverlap=8, window=np.ones(16), padded=False,
+        boundary=None, return_onesided=True)
+    np.testing.assert_allclose(st, ref_st * 16, rtol=1e-4, atol=1e-4)
+
+
+def test_householder_product_and_ormqr():
+    a = T((5, 3))
+    # LAPACK geqrf reflectors + taus via numpy's raw mode
+    geqrf, tau = np.linalg.qr(a, mode="raw")
+    q_ref = np.linalg.qr(a)[0]
+    got_q = paddle.linalg.householder_product(
+        paddle.to_tensor(geqrf.T.astype(np.float32).copy()),
+        paddle.to_tensor(tau.astype(np.float32))).numpy()
+    # Q is unique up to column signs given the reflectors — compare
+    # reconstruction instead: Q from reflectors must be orthonormal
+    # and span the same subspace
+    np.testing.assert_allclose(got_q.T @ got_q, np.eye(3), atol=1e-4)
+    np.testing.assert_allclose(np.abs(q_ref.T @ got_q),
+                               np.eye(3), atol=1e-4)
+    if hasattr(paddle.linalg, "ormqr"):
+        # ormqr applies the (full, implicit) orthogonal Q: it must
+        # preserve norms, and Q^T(Qc) must round-trip to c
+        c = T((5, 2))
+        refl = paddle.to_tensor(geqrf.T.astype(np.float32).copy())
+        taut = paddle.to_tensor(tau.astype(np.float32))
+        z = paddle.linalg.ormqr(refl, taut, paddle.to_tensor(c))
+        np.testing.assert_allclose(
+            np.linalg.norm(z.numpy(), axis=0),
+            np.linalg.norm(c, axis=0), rtol=1e-4)
+        back = paddle.linalg.ormqr(refl, taut, z,
+                                   transpose=True).numpy()
+        np.testing.assert_allclose(back, c, atol=1e-4)
+
+
+def test_alias_loss_schemas():
+    """bce_loss / kldiv_loss / hinge_loss /
+    sigmoid_cross_entropy_with_logits are kernel-level aliases of the
+    swept public losses — pin them to the same numerics."""
+    x = rng.uniform(0.05, 0.95, (4, 3)).astype(np.float32)
+    y = rng.randint(0, 2, (4, 3)).astype(np.float32)
+    _cmp(F.binary_cross_entropy(paddle.to_tensor(x),
+                                paddle.to_tensor(y)),
+         tF.binary_cross_entropy(torch.tensor(x),
+                                 torch.tensor(y)).numpy())
+    logit = T((4, 3))
+    _cmp(F.binary_cross_entropy_with_logits(paddle.to_tensor(logit),
+                                            paddle.to_tensor(y)),
+         tF.binary_cross_entropy_with_logits(
+             torch.tensor(logit), torch.tensor(y)).numpy())
+    logp = np.log(rng.uniform(0.1, 0.9, (4, 3))).astype(np.float32)
+    tgt = rng.uniform(0.1, 0.9, (4, 3)).astype(np.float32)
+    _cmp(F.kl_div(paddle.to_tensor(logp), paddle.to_tensor(tgt),
+                  reduction="batchmean"),
+         tF.kl_div(torch.tensor(logp), torch.tensor(tgt),
+                   reduction="batchmean").numpy())
+    lbl = (rng.randint(0, 2, (6,)) * 2 - 1).astype(np.float32)
+    inp = T((6,))
+    _cmp(F.hinge_embedding_loss(paddle.to_tensor(inp),
+                                paddle.to_tensor(lbl)),
+         tF.hinge_embedding_loss(torch.tensor(inp),
+                                 torch.tensor(lbl)).numpy())
+
+
+def test_unfold_im2col():
+    x = T((2, 3, 6, 6))
+    got = F.unfold(paddle.to_tensor(x), kernel_sizes=2, strides=2)
+    ref = tF.unfold(torch.tensor(x), 2, stride=2).numpy()
+    np.testing.assert_allclose(got.numpy(), ref, rtol=1e-5)
+
+
+def test_view_shape_alias():
+    x = paddle.to_tensor(T((2, 6)))
+    np.testing.assert_array_equal(x.view([3, 4]).numpy(),
+                                  x.numpy().reshape(3, 4))
+    np.testing.assert_array_equal(
+        paddle.reshape(x, [4, 3]).numpy(), x.numpy().reshape(4, 3))
+
+
+def test_shuffle_channel_alias():
+    x = T((2, 4, 3, 3))
+    got = F.channel_shuffle(paddle.to_tensor(x), 2)
+    ref = tF.channel_shuffle(torch.tensor(x), 2).numpy()
+    np.testing.assert_allclose(got.numpy(), ref)
