@@ -273,6 +273,15 @@ def cumprod(x, dim=None, dtype=None, name=None):
     return run_op("cumprod", lambda a: jnp.cumprod(a, axis=dim, dtype=d), x)
 
 
+def _index_dtype(dtype):
+    """int64 only when jax x64 is actually enabled; canonical int32
+    otherwise (avoids jax's warn-and-truncate on int64 requests)."""
+    d = dtype_mod.convert_dtype(dtype)
+    if d == np.int64 and not jax.config.jax_enable_x64:
+        return jnp.int32
+    return d
+
+
 def cummax(x, axis=None, dtype="int64", name=None):
     def f(a):
         if axis is None:
@@ -281,13 +290,15 @@ def cummax(x, axis=None, dtype="int64", name=None):
         else:
             a2, ax = a, axis
         vals = jax.lax.associative_scan(jnp.maximum, a2, axis=ax)
-        n = a2.shape[ax]
-        iota = jax.lax.broadcasted_iota(jnp.int64 if dtype == "int64"
-                                        else jnp.int32, a2.shape, ax)
+        # iota in int32 (dims always fit); the final index dtype is
+        # int64 only when x64 is actually on — requesting int64 with
+        # x64 off would make jax warn-and-truncate
+        d = _index_dtype(dtype)
+        iota = jax.lax.broadcasted_iota(jnp.int32, a2.shape, ax)
         eq = a2 == vals
         idx = jnp.where(eq, iota, 0)
         idx = jax.lax.associative_scan(jnp.maximum, idx, axis=ax)
-        return vals, idx
+        return vals, idx.astype(d)
     outs = run_op("cummax", f, x)
     return outs
 
@@ -300,12 +311,12 @@ def cummin(x, axis=None, dtype="int64", name=None):
         else:
             a2, ax = a, axis
         vals = jax.lax.associative_scan(jnp.minimum, a2, axis=ax)
-        iota = jax.lax.broadcasted_iota(jnp.int64 if dtype == "int64"
-                                        else jnp.int32, a2.shape, ax)
+        d = _index_dtype(dtype)
+        iota = jax.lax.broadcasted_iota(jnp.int32, a2.shape, ax)
         eq = a2 == vals
         idx = jnp.where(eq, iota, 0)
         idx = jax.lax.associative_scan(jnp.maximum, idx, axis=ax)
-        return vals, idx
+        return vals, idx.astype(d)
     return run_op("cummin", f, x)
 
 

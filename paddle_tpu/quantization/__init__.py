@@ -1,11 +1,17 @@
-"""paddle.quantization equivalent (reference: python/paddle/quantization —
-QAT/PTQ framework with QuantConfig, quanters, observers).
+"""paddle.quantization equivalent (reference: python/paddle/quantization
+— the QuantConfig / quanter-factory / QAT / PTQ framework:
+config.py QuantConfig with per-layer/name/type priority resolution,
+quanters/abs_max.py factories, qat.py + ptq.py flows, quantize.py
+convert).
 
 TPU-native: fake-quant (quantize-dequantize) runs as XLA elementwise
-graphs with straight-through-estimator gradients; int8 inference maps to
-XLA int8 dots on supporting hardware.
+graphs with straight-through-estimator gradients — the CUDA fake-quant
+kernels are one fused XLA expression; int8 inference maps to int8 dots
+/ weight-only dequant fused into the consumer matmul.
 """
 from __future__ import annotations
+
+from typing import Dict, List, Optional, Type
 
 import numpy as np
 import jax
@@ -17,10 +23,17 @@ from paddle_tpu.core.dispatch import run_op
 from paddle_tpu.core.tensor import Tensor
 
 
-def quantize_dequantize(x, scale, zero_point=0.0, bit_length=8):
-    """Fake-quant with STE gradient."""
+def quantize_dequantize(x, scale, zero_point=0.0, bit_length=8,
+                        channel_axis=None):
+    """Fake-quant with STE gradient; scale may be scalar or
+    per-channel (broadcast along channel_axis)."""
     qmin, qmax = -(2 ** (bit_length - 1)), 2 ** (bit_length - 1) - 1
+
     def f(a, s):
+        if channel_axis is not None and s.ndim == 1:
+            shape = [1] * a.ndim
+            shape[channel_axis] = -1
+            s = s.reshape(shape)
         s = jnp.maximum(s, 1e-8)
         q = jnp.clip(jnp.round(a / s), qmin, qmax)
         deq = q * s
@@ -29,8 +42,19 @@ def quantize_dequantize(x, scale, zero_point=0.0, bit_length=8):
     return run_op("fake_quant", f, x, scale)
 
 
-class AbsmaxObserver:
-    """PTQ observer collecting abs-max scale."""
+# ---------------------------------------------------------------------
+# Observers (reference quantization/observers)
+# ---------------------------------------------------------------------
+class BaseObserver:
+    def observe(self, x: Tensor):
+        raise NotImplementedError
+
+    def scale(self):
+        raise NotImplementedError
+
+
+class AbsmaxObserver(BaseObserver):
+    """Running abs-max (reference observers/abs_max.py)."""
 
     def __init__(self, bit_length=8):
         self.bit_length = bit_length
@@ -45,8 +69,66 @@ class AbsmaxObserver:
         return self._absmax / qmax if self._absmax else 1.0
 
 
+class EMAObserver(BaseObserver):
+    """Exponential-moving-average abs-max (smoother PTQ scales)."""
+
+    def __init__(self, bit_length=8, moving_rate=0.9):
+        self.bit_length = bit_length
+        self.moving_rate = moving_rate
+        self._val = None
+
+    def observe(self, x: Tensor):
+        cur = float(np.abs(np.asarray(x._data)).max())
+        self._val = cur if self._val is None else \
+            self.moving_rate * self._val + (1 - self.moving_rate) * cur
+
+    def scale(self):
+        qmax = 2 ** (self.bit_length - 1) - 1
+        return (self._val or 1.0) / qmax
+
+
+class GroupWiseWeightObserver(BaseObserver):
+    """Per-output-channel abs-max for weights (reference
+    observers/groupwise.py, group_size=1 per channel)."""
+
+    def __init__(self, bit_length=8, channel_axis=-1):
+        self.bit_length = bit_length
+        self.channel_axis = channel_axis
+        self._scales = None
+
+    def observe(self, w: Tensor):
+        a = np.abs(np.asarray(w._data))
+        ax = self.channel_axis % a.ndim
+        red = tuple(i for i in range(a.ndim) if i != ax)
+        qmax = 2 ** (self.bit_length - 1) - 1
+        self._scales = a.max(axis=red) / qmax
+
+    def scale(self):
+        return self._scales
+
+
+# ---------------------------------------------------------------------
+# Quanters (reference quantization/quanters) + factory pattern
+# ---------------------------------------------------------------------
+class QuanterFactory:
+    """Partial application of a quanter class (reference
+    factory.py quanter(...)): config stores factories, instantiation
+    happens once per wrapped layer."""
+
+    def __init__(self, cls, **kwargs):
+        self.cls = cls
+        self.kwargs = kwargs
+
+    def _instance(self):
+        return self.cls(**self.kwargs)
+
+    def __call__(self):
+        return self._instance()
+
+
 class FakeQuanterWithAbsMax(nn.Layer):
-    """QAT quanter: learns running abs-max scale."""
+    """QAT activation quanter: learns a running abs-max scale
+    (reference quanters/abs_max.py FakeQuanterWithAbsMaxObserver)."""
 
     def __init__(self, bit_length=8, moving_rate=0.9):
         super().__init__()
@@ -70,6 +152,27 @@ class FakeQuanterWithAbsMax(nn.Layer):
         return quantize_dequantize(x, self._scale, 0.0, self.bit_length)
 
 
+class FakeQuanterChannelWiseAbsMax(nn.Layer):
+    """Per-output-channel weight quanter (reference channel-wise
+    abs-max weight quantization)."""
+
+    def __init__(self, bit_length=8, channel_axis=-1):
+        super().__init__()
+        self.bit_length = bit_length
+        self.channel_axis = channel_axis
+
+    def forward(self, w):
+        qmax = 2 ** (self.bit_length - 1) - 1
+        ax = self.channel_axis % w.ndim
+        red = [i for i in range(w.ndim) if i != ax]
+        scale = paddle.max(paddle.abs(w), axis=red).detach() / qmax
+        return quantize_dequantize(w, scale, 0.0, self.bit_length,
+                                   channel_axis=ax)
+
+
+# ---------------------------------------------------------------------
+# Quanted layer wrappers (reference nn.qat.*)
+# ---------------------------------------------------------------------
 class QuantedLinear(nn.Layer):
     def __init__(self, linear: nn.Linear, bit_length=8,
                  act_quanter=None, weight_quanter=None):
@@ -86,31 +189,111 @@ class QuantedLinear(nn.Layer):
         return F.linear(xq, wq, self.inner.bias)
 
 
-class QuantConfig:
-    """activation/weight: optional factory callables returning a quanter
-    layer (reference passes FakeQuanter factories); bit_length applies
-    when the default FakeQuanterWithAbsMax is used."""
+class QuantedConv2D(nn.Layer):
+    def __init__(self, conv, bit_length=8, act_quanter=None,
+                 weight_quanter=None):
+        super().__init__()
+        self.inner = conv
+        self.act_quanter = act_quanter or FakeQuanterWithAbsMax(bit_length)
+        self.weight_quanter = weight_quanter or \
+            FakeQuanterWithAbsMax(bit_length)
 
+    def forward(self, x):
+        from paddle_tpu.nn import functional as F
+        xq = self.act_quanter(x)
+        wq = self.weight_quanter(self.inner.weight)
+        c = self.inner
+        return F.conv2d(xq, wq, c.bias, stride=c._stride,
+                        padding=c._padding, dilation=c._dilation,
+                        groups=c._groups, data_format=c._data_format)
+
+
+_DEFAULT_QAT_MAPPING = {nn.Linear: QuantedLinear,
+                        nn.Conv2D: QuantedConv2D}
+
+
+# ---------------------------------------------------------------------
+# QuantConfig with the reference's priority resolution
+# ---------------------------------------------------------------------
+class SingleLayerConfig:
     def __init__(self, activation=None, weight=None, bit_length=8):
         self.activation = activation
         self.weight = weight
         self.bit_length = bit_length
-        self._types = (nn.Linear,)
 
-    def add_type_config(self, layer_types, activation=None, weight=None):
-        self._types = tuple(layer_types) if isinstance(
-            layer_types, (list, tuple)) else (layer_types,)
-        if activation is not None:
-            self.activation = activation
-        if weight is not None:
-            self.weight = weight
 
-    def _make_quanted(self, child):
-        return QuantedLinear(
-            child, self.bit_length,
-            act_quanter=self.activation() if callable(self.activation)
+class QuantConfig:
+    """Where and how to quantize (reference config.py QuantConfig):
+    priority layer-instance > layer-name > layer-type > global default.
+    activation/weight take QuanterFactory (or any zero-arg callable
+    returning a quanter layer)."""
+
+    def __init__(self, activation=None, weight=None, bit_length=8):
+        self.default = SingleLayerConfig(activation, weight, bit_length)
+        self._by_layer: Dict[int, SingleLayerConfig] = {}
+        self._by_name: Dict[str, SingleLayerConfig] = {}
+        self._by_type: Dict[type, SingleLayerConfig] = {}
+        self.qat_mapping = dict(_DEFAULT_QAT_MAPPING)
+        self._types = tuple(self.qat_mapping)   # back-compat surface
+
+    def add_layer_config(self, layer, activation=None, weight=None,
+                         bit_length=8):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._by_layer[id(l)] = SingleLayerConfig(
+                activation, weight, bit_length)
+
+    def add_name_config(self, layer_name, activation=None, weight=None,
+                        bit_length=8):
+        names = layer_name if isinstance(layer_name, (list, tuple)) \
+            else [layer_name]
+        for n in names:
+            self._by_name[n] = SingleLayerConfig(activation, weight,
+                                                 bit_length)
+
+    def add_type_config(self, layer_type, activation=None, weight=None,
+                        bit_length=8):
+        types = layer_type if isinstance(layer_type, (list, tuple)) \
+            else [layer_type]
+        for t in types:
+            self._by_type[t] = SingleLayerConfig(activation, weight,
+                                                 bit_length)
+        self._types = tuple(set(self._types) | set(types))
+
+    def add_qat_layer_mapping(self, source: Type[nn.Layer],
+                              target: Type[nn.Layer]):
+        """Custom quanted wrapper for a layer type (reference
+        add_qat_layer_mapping)."""
+        self.qat_mapping[source] = target
+
+    # -- resolution ----------------------------------------------------
+    def _config_for(self, layer, full_name) -> Optional[SingleLayerConfig]:
+        if id(layer) in self._by_layer:
+            return self._by_layer[id(layer)]
+        if full_name in self._by_name:
+            return self._by_name[full_name]
+        for t, c in self._by_type.items():
+            if isinstance(layer, t):
+                return c
+        if isinstance(layer, tuple(self.qat_mapping)) and (
+                self.default.activation or self.default.weight
+                or not (self._by_layer or self._by_name
+                        or self._by_type)):
+            return self.default
+        return None
+
+    def _make_quanted(self, child, cfg: SingleLayerConfig):
+        wrapper = None
+        for t, w in self.qat_mapping.items():
+            if isinstance(child, t):
+                wrapper = w
+        if wrapper is None:
+            return None
+        return wrapper(
+            child, cfg.bit_length,
+            act_quanter=cfg.activation() if callable(cfg.activation)
             else None,
-            weight_quanter=self.weight() if callable(self.weight)
+            weight_quanter=cfg.weight() if callable(cfg.weight)
             else None)
 
 
@@ -121,25 +304,45 @@ def _maybe_copy(model, inplace):
     return copy.deepcopy(model)
 
 
+# ---------------------------------------------------------------------
+# QAT / PTQ flows (reference qat.py / ptq.py)
+# ---------------------------------------------------------------------
 class QAT:
-    """Quantization-aware training: swap Linear -> QuantedLinear."""
+    """Quantization-aware training: walk the model, wrap every layer
+    the config resolves, honoring the qat layer mapping."""
 
     def __init__(self, config: QuantConfig):
         self.config = config
 
     def quantize(self, model: nn.Layer, inplace=False):
         model = _maybe_copy(model, inplace)
+        quanted_types = tuple(self.config.qat_mapping.values())
         for name, layer in list(model.named_sublayers(include_self=True)):
             for cname, child in list(layer._sub_layers.items()):
-                if isinstance(child, self.config._types) and \
-                        not isinstance(child, QuantedLinear):
-                    layer.add_sublayer(cname,
-                                       self.config._make_quanted(child))
+                if isinstance(child, quanted_types):
+                    continue
+                full = f"{name}.{cname}" if name else cname
+                cfg = self.config._config_for(child, full)
+                if cfg is None:
+                    continue
+                q = self.config._make_quanted(child, cfg)
+                if q is not None:
+                    layer.add_sublayer(cname, q)
+        return model
+
+    def convert(self, model: nn.Layer, inplace=False):
+        """Freeze a trained QAT model for inference: quanters stop
+        updating and keep their learned scales (reference
+        quantize.py convert)."""
+        model = _maybe_copy(model, inplace)
+        model.eval()
         return model
 
 
 class PTQ:
     """Post-training quantization: observe activations, then freeze."""
+
+    observer_cls = AbsmaxObserver
 
     def __init__(self, config: QuantConfig = None):
         self.config = config or QuantConfig()
@@ -149,25 +352,27 @@ class PTQ:
         model = _maybe_copy(model, inplace)
         self._hooks = []
         for name, layer in model.named_sublayers(include_self=True):
-            if isinstance(layer, self.config._types):
-                obs = AbsmaxObserver(self.config.bit_length)
-                self._observers[id(layer)] = obs
+            for cname, child in list(layer._sub_layers.items()):
+                full = f"{name}.{cname}" if name else cname
+                cfg = self.config._config_for(child, full)
+                if cfg is None:
+                    continue
+                obs = self.observer_cls(cfg.bit_length)
+                self._observers[id(child)] = obs
 
                 def hook(l, inputs, _obs=obs):
                     _obs.observe(inputs[0])
                 self._hooks.append(
-                    layer.register_forward_pre_hook(hook))
+                    child.register_forward_pre_hook(hook))
         return model
 
     def convert(self, model: nn.Layer, inplace=False):
         # convert must run on the same instance that was observed
-        # (observers are keyed by layer identity); inplace=False returns a
-        # converted deep copy while leaving `model` un-quantized.
+        # (observers are keyed by layer identity); inplace=False returns
+        # a converted deep copy while leaving `model` un-quantized.
         for h in getattr(self, "_hooks", []):
             h.remove()
         target = _maybe_copy(model, inplace)
-        bits = self.config.bit_length
-        qmax = 2 ** (bits - 1) - 1
         src_layers = dict(model.named_sublayers(include_self=True))
         for name, layer in list(target.named_sublayers(include_self=True)):
             for cname, child in list(layer._sub_layers.items()):
@@ -175,16 +380,34 @@ class PTQ:
                 src_child = src_parent._sub_layers.get(cname) \
                     if src_parent is not None else None
                 obs = self._observers.get(id(src_child))
-                if obs is not None:
-                    scale = obs.scale()
-                    q = QuantedLinear(child, bits)
+                if obs is None:
+                    continue
+                full = f"{name}.{cname}" if name else cname
+                cfg = self.config._config_for(child, full) or \
+                    self.config.default
+                bits = cfg.bit_length
+                qmax = 2 ** (bits - 1) - 1
+                q = self.config._make_quanted(child, cfg)
+                if q is None:
+                    continue
+                if hasattr(q.act_quanter, "_scale"):
                     q.act_quanter._scale._assign_array(
-                        jnp.asarray([scale], jnp.float32))
-                    q.act_quanter.eval()
-                    q.weight_quanter.eval()
+                        jnp.asarray([obs.scale()], jnp.float32))
+                if hasattr(q.weight_quanter, "_scale"):
                     wmax = float(np.abs(np.asarray(
                         child.weight._data)).max())
                     q.weight_quanter._scale._assign_array(
                         jnp.asarray([wmax / qmax], jnp.float32))
-                    layer.add_sublayer(cname, q)
+                q.eval()
+                layer.add_sublayer(cname, q)
         return target
+
+
+def quanter(cls=None, **kwargs):
+    """Factory decorator/constructor (reference factory.quanter):
+    quanter(FakeQuanterWithAbsMax, bit_length=4) -> QuanterFactory."""
+    if cls is None:
+        def deco(c):
+            return QuanterFactory(c, **kwargs)
+        return deco
+    return QuanterFactory(cls, **kwargs)

@@ -1,0 +1,110 @@
+"""Inference serving stack (paddle.inference — reference
+AnalysisPredictor: config passes, zero-copy IO, engine caching)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.inference import Config, create_predictor
+
+rng = np.random.RandomState(3)
+
+
+def _model():
+    paddle.seed(7)
+    return nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+
+
+def _cfg(model, **passes):
+    cfg = Config()
+    cfg.set_layer(model)
+    return cfg
+
+
+def test_basic_predict_and_handles():
+    model = _model()
+    cfg = _cfg(model)
+    pred = create_predictor(cfg)
+    x = rng.randn(5, 8).astype(np.float32)
+    h = pred.get_input_handle("x")
+    h.copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle("out").copy_to_cpu()
+    ref = model(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_low_precision_pass():
+    model = _model()
+    ref = model(paddle.to_tensor(rng.randn(4, 8).astype(np.float32)))
+    cfg = _cfg(model)
+    cfg.enable_low_precision_inference("bfloat16")
+    pred = create_predictor(cfg)
+    x = rng.randn(4, 8).astype(np.float32)
+    out = pred.run([paddle.to_tensor(x)])[0]
+    assert "bfloat16" in str(out.numpy().dtype) or \
+        out.numpy().dtype == np.float32  # cast back on fetch is fine
+    ref = _model()(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(np.asarray(out.numpy(), np.float32),
+                               ref, rtol=0.05, atol=0.05)
+
+
+def test_int8_weight_only_pass():
+    model = _model()
+    x = rng.randn(6, 8).astype(np.float32)
+    ref = model(paddle.to_tensor(x)).numpy()
+    cfg = _cfg(model)
+    cfg.enable_int8_weight_only()
+    pred = create_predictor(cfg)
+    out = pred.run([paddle.to_tensor(x)])[0].numpy()
+    # int8 weight-only: ~1% relative error budget
+    np.testing.assert_allclose(out, ref, rtol=0.1, atol=0.05)
+    # quantized payloads retained for introspection
+    q_found = [p for _, p in model.named_parameters()
+               if hasattr(p, "_int8_payload")]
+    assert q_found and q_found[0]._int8_payload[0].dtype == np.int8
+
+
+def test_shape_bucketing_bounds_executables():
+    model = _model()
+    cfg = _cfg(model)
+    cfg.enable_shape_bucketing([4, 8, 16])
+    pred = create_predictor(cfg)
+    pred.warmup([[4, 8], [8, 8], [16, 8]])
+    n0 = pred.get_execution_stats()["executables"]
+    # every odd batch size maps onto the ladder: no new executables
+    for b in (1, 3, 5, 7, 11, 13):
+        x = rng.randn(b, 8).astype(np.float32)
+        out = pred.run([paddle.to_tensor(x)])[0].numpy()
+        assert out.shape == (b, 4)
+        ref = model(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+    assert pred.get_execution_stats()["executables"] == n0
+    assert pred.get_execution_stats()["bucket_pad_total"] >= 6
+
+
+def test_async_predict():
+    pred = create_predictor(_cfg(_model()))
+    fut = pred.run_async([paddle.to_tensor(
+        rng.randn(4, 8).astype(np.float32))])
+    outs = fut.get()
+    assert fut.done() and outs[0].shape == [4, 4]
+
+
+def test_share_external_data_zero_copy():
+    import jax.numpy as jnp
+    pred = create_predictor(_cfg(_model()))
+    dev = jnp.asarray(rng.randn(3, 8).astype(np.float32))
+    h = pred.get_input_handle("x")
+    h.share_external_data(dev)
+    assert h._t._data is dev            # adopted, not copied
+    out = pred.run()[0]
+    assert out.shape == [3, 4]
+
+
+def test_stats_and_warmup():
+    pred = create_predictor(_cfg(_model()))
+    pred.warmup([[2, 8]])
+    s = pred.get_execution_stats()
+    assert s["runs"] == 1 and s["warmup_shapes"] == [(2, 8)]
+    assert s["last_latency_ms"] is not None

@@ -1,0 +1,133 @@
+"""Quantization framework (reference: python/paddle/quantization —
+QuantConfig priority resolution, quanter factories, QAT insertion over
+layer graphs, PTQ calibrate->convert)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.quantization import (AbsmaxObserver, EMAObserver,
+                                     FakeQuanterChannelWiseAbsMax,
+                                     FakeQuanterWithAbsMax,
+                                     GroupWiseWeightObserver, PTQ, QAT,
+                                     QuantConfig, QuantedConv2D,
+                                     QuantedLinear, quanter)
+
+rng = np.random.RandomState(5)
+
+
+def _mlp():
+    paddle.seed(9)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+def _cnn():
+    paddle.seed(9)
+    return nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+                         nn.Conv2D(8, 4, 3, padding=1))
+
+
+def test_qat_default_wraps_linear_and_conv():
+    q = QAT(QuantConfig())
+    mlp = q.quantize(_mlp())
+    kinds = [type(l) for l in mlp]
+    assert kinds[0] is QuantedLinear and kinds[2] is QuantedLinear
+    cnn = q.quantize(_cnn())
+    assert isinstance(cnn[0], QuantedConv2D)
+    assert isinstance(cnn[2], QuantedConv2D)
+
+
+def test_config_priority_instance_over_name_over_type():
+    model = _mlp()
+    cfg = QuantConfig(activation=None, weight=None)
+    cfg.add_type_config(nn.Linear, bit_length=8)
+    cfg.add_name_config("2", bit_length=4)          # second Linear
+    cfg.add_layer_config(model[0], bit_length=2)    # first Linear
+    q = QAT(cfg)
+    out = q.quantize(model, inplace=True)
+    assert out[0].act_quanter.bit_length == 2       # instance wins
+    assert out[2].act_quanter.bit_length == 4       # name beats type
+
+
+def test_quanter_factory_and_custom_mapping():
+    class MyQuanted(QuantedLinear):
+        pass
+
+    cfg = QuantConfig(
+        activation=quanter(FakeQuanterWithAbsMax, bit_length=4),
+        weight=quanter(FakeQuanterChannelWiseAbsMax, bit_length=8))
+    cfg.add_qat_layer_mapping(nn.Linear, MyQuanted)
+    out = QAT(cfg).quantize(_mlp())
+    assert isinstance(out[0], MyQuanted)
+    assert out[0].act_quanter.bit_length == 4
+    assert isinstance(out[0].weight_quanter,
+                      FakeQuanterChannelWiseAbsMax)
+
+
+def test_qat_trains_and_stays_close():
+    model = _mlp()
+    x = rng.randn(16, 8).astype(np.float32)
+    ref = model(paddle.to_tensor(x)).numpy()
+    qmodel = QAT(QuantConfig()).quantize(model)
+    opt = paddle.optimizer.SGD(learning_rate=1e-2,
+                               parameters=qmodel.parameters())
+    y = paddle.to_tensor(rng.randn(16, 4).astype(np.float32))
+    losses = []
+    for _ in range(10):
+        out = qmodel(paddle.to_tensor(x))
+        loss = ((out - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss._data))
+    assert losses[-1] < losses[0]       # STE gradients flow
+    # 8-bit fake-quant forward stays close to fp32 before training
+    out0 = QAT(QuantConfig()).quantize(_mlp())(
+        paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out0, ref, rtol=0.1, atol=0.1)
+
+
+def test_channelwise_weight_quanter_smaller_error():
+    w = paddle.to_tensor(
+        (rng.randn(8, 16) * np.logspace(-2, 0, 16)).astype(np.float32))
+    per_tensor = FakeQuanterWithAbsMax()
+    per_tensor.eval()
+    # seed the per-tensor scale as PTQ would
+    per_tensor._scale._assign_array(
+        np.abs(w.numpy()).max(keepdims=True).reshape(1) / 127)
+    pc = FakeQuanterChannelWiseAbsMax()
+    err_t = np.abs(per_tensor(w).numpy() - w.numpy()).mean()
+    err_c = np.abs(pc(w).numpy() - w.numpy()).mean()
+    assert err_c < err_t                # per-channel strictly better
+
+
+def test_ptq_calibrate_convert():
+    model = _mlp()
+    x = rng.randn(32, 8).astype(np.float32)
+    ref = model(paddle.to_tensor(x)).numpy()
+    ptq = PTQ(QuantConfig())
+    model = ptq.quantize(model, inplace=True)
+    for i in range(4):                  # calibration passes
+        model(paddle.to_tensor(x + 0.01 * i))
+    converted = ptq.convert(model)
+    out = converted(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=0.15, atol=0.1)
+    # scales were frozen from the observers, not ones
+    assert float(converted[0].act_quanter._scale.numpy()[0]) != 1.0
+    # original left unquantized with inplace=False convert
+    assert isinstance(model[0], nn.Linear)
+
+
+def test_observers():
+    o = AbsmaxObserver()
+    o.observe(paddle.to_tensor([1.0, -3.0]))
+    o.observe(paddle.to_tensor([2.0]))
+    assert abs(o.scale() - 3.0 / 127) < 1e-6
+    e = EMAObserver(moving_rate=0.5)
+    e.observe(paddle.to_tensor([2.0]))
+    e.observe(paddle.to_tensor([4.0]))
+    assert abs(e.scale() - 3.0 / 127) < 1e-6
+    g = GroupWiseWeightObserver(channel_axis=-1)
+    g.observe(paddle.to_tensor(np.array([[1.0, -8.0], [2.0, 4.0]],
+                                        np.float32)))
+    np.testing.assert_allclose(g.scale(), [2.0 / 127, 8.0 / 127])
