@@ -155,7 +155,9 @@ class Predictor:
             return                      # weights frozen in the program
         if cfg._int8_weights:
             self._int8_rewrite()
-        elif cfg._precision is not None:
+        if cfg._precision is not None:
+            # composes with int8: QDQ'd weights are then SERVED in the
+            # low precision (int8-emulated values, bf16 compute)
             for _, p in self._layer.named_parameters():
                 if jnp.issubdtype(p._data.dtype, jnp.floating):
                     p._assign_array(p._data.astype(cfg._precision))
@@ -200,14 +202,16 @@ class Predictor:
         """Pad the BATCH dim (the first input's leading dim) up to the
         bucket ladder. Only inputs sharing that batch size are padded —
         side inputs (lookup tables, per-position tensors) pass through
-        untouched; outputs are trimmed back to the true batch."""
+        untouched; outputs whose leading dim is the padded batch are
+        trimmed back. Returns (args, true_batch, padded_batch);
+        (args, 0, 0) means no padding happened."""
         buckets = self._config._buckets
         if not buckets or not args:
-            return args, 0
+            return args, 0, 0
         batch = args[0].shape[0]
         tgt = next((k for k in buckets if k >= batch), buckets[-1])
         if tgt <= batch:
-            return args, 0
+            return args, 0, 0
         out = []
         for a in args:
             if a.shape[0] == batch:
@@ -215,7 +219,46 @@ class Predictor:
                 out.append(Tensor._wrap(jnp.pad(a._data, pad), True))
             else:
                 out.append(a)
-        return out, batch
+        return out, batch, tgt
+
+    def _batch_output_flags(self, args):
+        """Which outputs carry the batch on dim 0? Probed with
+        jax.eval_shape at two different batch sizes (no execution, no
+        compile): a dim that moves with the batch is batch-carrying.
+        None when the model cannot be abstractly evaluated."""
+        key = (len(args),) + tuple(a._data.dtype.name for a in args)
+        if key in getattr(self, "_flag_cache", {}):
+            return self._flag_cache[key]
+        if not hasattr(self, "_flag_cache"):
+            self._flag_cache = {}
+        batch = args[0].shape[0]
+
+        def shapes_at(b):
+            specs = []
+            for a in args:
+                shp = list(a._data.shape)
+                if shp and shp[0] == batch:
+                    shp[0] = b
+                specs.append(jax.ShapeDtypeStruct(tuple(shp),
+                                                  a._data.dtype))
+
+            def fn(*xs):
+                with paddle.no_grad():
+                    o = self._layer(*[Tensor._wrap(x, True)
+                                      for x in xs])
+                o = [o] if isinstance(o, Tensor) else list(o)
+                return [t._data for t in o]
+            return jax.eval_shape(fn, *specs)
+
+        try:
+            s1 = shapes_at(max(batch, 1))
+            s2 = shapes_at(max(batch, 1) + 1)
+            flags = [a.shape[:1] != b.shape[:1]
+                     for a, b in zip(s1, s2)]
+        except Exception:
+            flags = None                # fall back to the heuristic
+        self._flag_cache[key] = flags
+        return flags
 
     def _ensure_compiled(self):
         if self._compiled is None:
@@ -255,15 +298,50 @@ class Predictor:
                                  True)
                     if jnp.issubdtype(a._data.dtype, jnp.floating)
                     else a for a in args]
-        args, trimmed = self._bucketize(args)
+        buckets = self._config._buckets
+        if buckets and args and args[0].shape[0] > buckets[-1]:
+            # bigger than the top bucket: chunk into top-bucket pieces
+            # so the executable count stays bounded by the ladder.
+            # Valid only when every output carries the batch — an
+            # aggregate output cannot be reassembled from chunks, so
+            # such models run unbucketed at this size (correctness
+            # over the executable bound).
+            flags = self._batch_output_flags(args)
+            if flags is not None and all(flags):
+                top = buckets[-1]
+                batch = args[0].shape[0]
+                pieces = []
+                for lo in range(0, batch, top):
+                    part = [Tensor._wrap(a._data[lo:lo + top], True)
+                            if a.shape[0] == batch else a for a in args]
+                    pieces.append(self.run(part))
+                outs = [Tensor._wrap(
+                    jnp.concatenate([p[i]._data for p in pieces], 0),
+                    True) for i in range(len(pieces[0]))]
+                self._last_out = outs[0]
+                return outs
+        if self._config._buckets and args:
+            flags = self._batch_output_flags(args)
+        args, true_batch, padded = self._bucketize(args)
         self._ensure_compiled()
         t0 = time.perf_counter()
         with paddle.no_grad():
             out = self._compiled(*args)
         outs = [out] if isinstance(out, Tensor) else list(out)
-        if trimmed:
-            outs = [Tensor._wrap(o._data[:trimmed], True) for o in outs]
+        if true_batch:
+            # trim ONLY the outputs whose leading dim actually tracks
+            # the batch (probed abstractly — a [C] aggregate that
+            # happens to equal the padded size must NOT be cut)
+            outs = [Tensor._wrap(o._data[:true_batch], True)
+                    if (flags[i] if flags is not None and i < len(flags)
+                        else o._data.ndim >= 1 and o.shape[0] == padded)
+                    else o
+                    for i, o in enumerate(outs)]
             self.stats["bucket_pad_total"] += 1
+        # latency means device completion, not async dispatch (on the
+        # tunneled backend block_until_ready can ack early; this is
+        # still the closest generic barrier)
+        jax.block_until_ready([o._data for o in outs])
         self.stats["runs"] += 1
         self.stats["last_latency_ms"] = (time.perf_counter() - t0) * 1e3
         self._last_out = outs[0]

@@ -272,9 +272,12 @@ class QuantConfig:
             return self._by_layer[id(layer)]
         if full_name in self._by_name:
             return self._by_name[full_name]
-        for t, c in self._by_type.items():
-            if isinstance(layer, t):
-                return c
+        matches = [t for t in self._by_type if isinstance(layer, t)]
+        if matches:
+            # most-derived type wins (a subclass config must beat its
+            # base class regardless of registration order)
+            best = max(matches, key=lambda t: len(t.__mro__))
+            return self._by_type[best]
         if isinstance(layer, tuple(self.qat_mapping)) and (
                 self.default.activation or self.default.weight
                 or not (self._by_layer or self._by_name
@@ -283,12 +286,11 @@ class QuantConfig:
         return None
 
     def _make_quanted(self, child, cfg: SingleLayerConfig):
-        wrapper = None
-        for t, w in self.qat_mapping.items():
-            if isinstance(child, t):
-                wrapper = w
-        if wrapper is None:
+        matches = [t for t in self.qat_mapping if isinstance(child, t)]
+        if not matches:
             return None
+        wrapper = self.qat_mapping[
+            max(matches, key=lambda t: len(t.__mro__))]
         return wrapper(
             child, cfg.bit_length,
             act_quanter=cfg.activation() if callable(cfg.activation)
@@ -304,6 +306,19 @@ def _maybe_copy(model, inplace):
     return copy.deepcopy(model)
 
 
+def _warn_if_root_quantizable(model, config):
+    """Wrapping happens by swapping a child on its parent; the ROOT
+    layer has no parent, so a bare quantizable model cannot be wrapped
+    — tell the user instead of silently no-opping."""
+    if config._config_for(model, "") is not None:
+        import warnings
+        warnings.warn(
+            f"the model itself is a quantizable {type(model).__name__}; "
+            "the root layer cannot be swapped in place — wrap it in a "
+            "container (e.g. nn.Sequential(model)) to quantize it",
+            stacklevel=3)
+
+
 # ---------------------------------------------------------------------
 # QAT / PTQ flows (reference qat.py / ptq.py)
 # ---------------------------------------------------------------------
@@ -316,6 +331,7 @@ class QAT:
 
     def quantize(self, model: nn.Layer, inplace=False):
         model = _maybe_copy(model, inplace)
+        _warn_if_root_quantizable(model, self.config)
         quanted_types = tuple(self.config.qat_mapping.values())
         for name, layer in list(model.named_sublayers(include_self=True)):
             for cname, child in list(layer._sub_layers.items()):
@@ -350,6 +366,7 @@ class PTQ:
 
     def quantize(self, model: nn.Layer, inplace=False):
         model = _maybe_copy(model, inplace)
+        _warn_if_root_quantizable(model, self.config)
         self._hooks = []
         for name, layer in model.named_sublayers(include_self=True):
             for cname, child in list(layer._sub_layers.items()):

@@ -36,7 +36,6 @@ def test_basic_predict_and_handles():
 
 def test_low_precision_pass():
     model = _model()
-    ref = model(paddle.to_tensor(rng.randn(4, 8).astype(np.float32)))
     cfg = _cfg(model)
     cfg.enable_low_precision_inference("bfloat16")
     pred = create_predictor(cfg)
