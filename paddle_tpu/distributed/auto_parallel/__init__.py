@@ -1,0 +1,32 @@
+"""paddle.distributed.auto_parallel / fleet.auto namespace.
+
+Reference layout (python/paddle/distributed/auto_parallel/): dygraph
+semi-auto API (shard_tensor/reshard/ProcessMesh — here in
+distributed/api.py over GSPMD) + the static side (engine.py Engine,
+completion.py, planner_v2.py, partitioner.py).
+
+TPU-native mapping: completion (dist-attr propagation across the
+graph) and the partitioner (per-rank program split) ARE GSPMD — jax
+propagates shardings and partitions the XLA program; the planner is
+distributed/planner.py (calibrated cost-model search); the Engine here
+ties them into the reference's fit/evaluate/predict/cost surface.
+"""
+from paddle_tpu.distributed.api import (DistAttr, DistModel, Strategy,
+                                        dtensor_from_fn, reshard,
+                                        shard_dataloader, shard_layer,
+                                        shard_optimizer, shard_scaler,
+                                        shard_tensor, to_static)
+from paddle_tpu.distributed.mesh import (Partial, Placement,
+                                         ProcessMesh, Replicate, Shard)
+from paddle_tpu.distributed.planner import (ModelSpec, PlanCandidate,
+                                            Planner)
+
+from .engine import Engine  # noqa: E402
+
+__all__ = [
+    "Engine", "Strategy", "DistModel", "DistAttr", "to_static",
+    "shard_tensor", "shard_layer", "shard_optimizer", "shard_dataloader",
+    "shard_scaler", "reshard", "dtensor_from_fn",
+    "ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
+    "Planner", "ModelSpec", "PlanCandidate",
+]

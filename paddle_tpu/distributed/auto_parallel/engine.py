@@ -1,0 +1,208 @@
+"""Auto-parallel Engine (reference: distributed/auto_parallel/static/
+engine.py:98 — Engine(model, loss, optimizer, metrics, strategy) with
+fit/evaluate/predict/cost over an automatically planned distributed
+program).
+
+TPU-native decomposition of the reference pipeline:
+  completion  -> GSPMD sharding propagation (jax inserts collectives)
+  partitioner -> the XLA SPMD partitioner (per-device program split)
+  planner_v2  -> distributed/planner.py (calibrated cost-model search)
+  engine      -> this class: plans a parallel config for the attached
+                 devices, builds the mesh, shards the data stream, and
+                 compiles one train/eval step (jit.to_static threads
+                 model+optimizer state functionally)
+
+Generic user models execute the data-parallel family of plans
+(dp x ZeRO — batch sharded over the mesh, GSPMD handles the rest).
+Plans that require tensor/pipeline-parallel STRUCTURE (tp/pp > 1)
+cannot be imposed on arbitrary python layers; the engine reports them
+via .plan()/.cost() and raises with a pointer to the hybrid engine
+(models/gpt_hybrid) and fleet mp/pp layers that implement them.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.planner import ModelSpec, Planner
+
+
+class Engine:
+    def __init__(self, model=None, loss=None, optimizer=None,
+                 metrics=None, strategy=None, chip: str = "v5e"):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.metrics = metrics if isinstance(metrics, (list, tuple)) \
+            else ([metrics] if metrics is not None else [])
+        self.strategy = strategy
+        self._chip = chip
+        self._devices = None
+        self._mesh = None
+        self._plan = None
+        self._step = None
+        self._eval_step = None
+        self.history = []
+
+    # ------------------------------------------------------------ plan
+    def _model_spec(self) -> ModelSpec:
+        n = sum(int(np.prod(p.shape))
+                for _, p in self.model.named_parameters())
+        # generic-layer spec: no transformer geometry — only the
+        # parameter count and a nominal seq drive the estimate
+        return ModelSpec(float(n), layers=1, hidden=1, heads=1, seq=1,
+                         vocab=1)
+
+    def plan(self, n_chips: Optional[int] = None, global_batch: int = 32,
+             top_k: int = 5):
+        """Ranked parallel plans for this model on n_chips (reference
+        planner_v2 through the Engine). Generic layers restrict the
+        executable family to dp x ZeRO<=1."""
+        n = n_chips or len(jax.devices())
+        planner = Planner(self._chip, zero_stages=(0, 1))
+        return planner.plan(self._model_spec(), n, global_batch,
+                            top_k=top_k)
+
+    def cost(self, n_chips: Optional[int] = None, global_batch: int = 32):
+        """Estimated (step_seconds, per_chip_memory_bytes) of the best
+        plan — the reference Engine.cost surface."""
+        best = self.plan(n_chips, global_batch)[0]
+        return best.est_step_s, best.est_mem_bytes
+
+    # --------------------------------------------------------- prepare
+    def prepare(self, n_chips: Optional[int] = None,
+                global_batch: int = 32):
+        import paddle_tpu as paddle
+
+        self._devices = jax.devices()[:n_chips] if n_chips else \
+            jax.devices()
+        best = self.plan(len(self._devices), global_batch)[0]
+        if best.tp > 1 or best.pp > 1:
+            raise NotImplementedError(
+                f"the planner chose {best.short()}, which needs model "
+                "structure the generic Engine cannot impose on "
+                "arbitrary layers; use models/gpt_hybrid (tp/pp/sp "
+                "engine) or fleet mp/pp layers for that plan")
+        self._plan = best
+        self._mesh = Mesh(np.asarray(self._devices[:best.dp]), ("dp",))
+
+        def train_step(xb, yb):
+            out = self.model(xb)
+            loss = self.loss(out, yb)
+            loss.backward()
+            self.optimizer.step()
+            self.optimizer.clear_grad()
+            return loss
+
+        def eval_step(xb, yb):
+            out = self.model(xb)
+            return self.loss(out, yb)
+
+        self._step = paddle.jit.to_static(
+            train_step, objs=[self.model, self.optimizer])
+        self._eval_step = paddle.jit.to_static(eval_step,
+                                               objs=[self.model])
+        return self
+
+    def _shard_batch(self, arr):
+        """Place a host batch sharded over the dp axis (GSPMD completes
+        the rest of the program's shardings from this seed)."""
+        a = arr._data if isinstance(arr, Tensor) else jnp.asarray(arr)
+        if self._plan.dp > 1 and a.shape[0] % self._plan.dp == 0:
+            a = jax.device_put(
+                a, NamedSharding(self._mesh,
+                                 P("dp", *([None] * (a.ndim - 1)))))
+        return Tensor._wrap(a, stop_gradient=True)
+
+    # ------------------------------------------------------------- fit
+    def fit(self, train_data, epochs: int = 1, batch_size: int = 32,
+            steps_per_epoch: Optional[int] = None, log_freq: int = 0,
+            valid_data=None):
+        """reference Engine.fit: iterate the data source, one compiled
+        step per batch, batch sharded over the planned mesh."""
+        import paddle_tpu as paddle
+
+        if self._step is None:
+            self.prepare(global_batch=batch_size)
+        loader = self._as_loader(train_data, batch_size)
+        for epoch in range(epochs):
+            losses = []
+            for i, batch in enumerate(loader):
+                if steps_per_epoch and i >= steps_per_epoch:
+                    break
+                xb, yb = batch[0], batch[1]
+                with self._mesh:
+                    loss = self._step(self._shard_batch(xb),
+                                      self._shard_batch(yb))
+                losses.append(float(loss._data))
+                if log_freq and i % log_freq == 0:
+                    print(f"[engine] epoch {epoch} step {i} "
+                          f"loss {losses[-1]:.4f}")
+            entry = {"epoch": epoch,
+                     "loss": float(np.mean(losses)) if losses else None}
+            if valid_data is not None:
+                entry["eval_loss"] = self.evaluate(valid_data,
+                                                   batch_size)
+            self.history.append(entry)
+        return self.history
+
+    def evaluate(self, eval_data, batch_size: int = 32,
+                 steps: Optional[int] = None):
+        if self._eval_step is None:
+            self.prepare(global_batch=batch_size)
+        self.model.eval()
+        losses = []
+        for i, batch in enumerate(self._as_loader(eval_data, batch_size)):
+            if steps and i >= steps:
+                break
+            with self._mesh:
+                loss = self._eval_step(self._shard_batch(batch[0]),
+                                       self._shard_batch(batch[1]))
+            losses.append(float(loss._data))
+        self.model.train()
+        return float(np.mean(losses)) if losses else None
+
+    def predict(self, data, batch_size: int = 32):
+        import paddle_tpu as paddle
+        if self._mesh is None:
+            self.prepare(global_batch=batch_size)
+        self.model.eval()
+        outs = []
+        with paddle.no_grad():
+            for batch in self._as_loader(data, batch_size,
+                                         with_label=False):
+                xb = batch[0] if isinstance(batch, (list, tuple)) \
+                    else batch
+                with self._mesh:
+                    outs.append(self.model(self._shard_batch(xb)))
+        self.model.train()
+        return outs
+
+    # ------------------------------------------------------------ misc
+    def _as_loader(self, data, batch_size, with_label=True):
+        from paddle_tpu.io import DataLoader, Dataset
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=False)
+        return data                      # any iterable of batches
+
+    def save(self, path, training=True):
+        import paddle_tpu as paddle
+        state = {"model": self.model.state_dict()}
+        if training and self.optimizer is not None:
+            state["optimizer"] = self.optimizer.state_dict()
+        paddle.save(state, path)
+
+    def load(self, path):
+        import paddle_tpu as paddle
+        state = paddle.load(path)
+        self.model.set_state_dict(state["model"])
+        if self.optimizer is not None and "optimizer" in state:
+            self.optimizer.set_state_dict(state["optimizer"])
+        return self

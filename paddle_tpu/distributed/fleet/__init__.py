@@ -336,3 +336,8 @@ def init_worker(scopes=None):
 
 def stop_worker():
     return _fleet.stop_worker()
+
+# fleet.auto: the auto-parallel namespace (reference fleet's `auto`
+# re-export of distributed.auto_parallel — Engine, shard_* API, planner)
+from paddle_tpu.distributed import auto_parallel as auto  # noqa: E402,F401
+__all__.append("auto")

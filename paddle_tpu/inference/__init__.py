@@ -207,11 +207,11 @@ class Predictor:
         (args, 0, 0) means no padding happened."""
         buckets = self._config._buckets
         if not buckets or not args:
-            return args, 0, 0
+            return args, None, None
         batch = args[0].shape[0]
         tgt = next((k for k in buckets if k >= batch), buckets[-1])
         if tgt <= batch:
-            return args, 0, 0
+            return args, None, None
         out = []
         for a in args:
             if a.shape[0] == batch:
@@ -222,11 +222,16 @@ class Predictor:
         return out, batch, tgt
 
     def _batch_output_flags(self, args):
-        """Which outputs carry the batch on dim 0? Probed with
-        jax.eval_shape at two different batch sizes (no execution, no
-        compile): a dim that moves with the batch is batch-carrying.
+        """Per-output batch relationship, probed with jax.eval_shape at
+        two batch sizes (no execution, no compile):
+          True  — dim0 IS the batch (safe to pad + trim)
+          False — dim0 is batch-independent (pass through)
+          "affine" — dim0 depends on the batch but is not equal to it
+                     (e.g. 2*B): padding cannot be undone by trimming,
+                     so bucketing must be skipped entirely
         None when the model cannot be abstractly evaluated."""
-        key = (len(args),) + tuple(a._data.dtype.name for a in args)
+        key = tuple((tuple(a._data.shape), a._data.dtype.name)
+                    for a in args)
         if key in getattr(self, "_flag_cache", {}):
             return self._flag_cache[key]
         if not hasattr(self, "_flag_cache"):
@@ -251,10 +256,19 @@ class Predictor:
             return jax.eval_shape(fn, *specs)
 
         try:
-            s1 = shapes_at(max(batch, 1))
-            s2 = shapes_at(max(batch, 1) + 1)
-            flags = [a.shape[:1] != b.shape[:1]
-                     for a, b in zip(s1, s2)]
+            b1, b2 = max(batch, 1), max(batch, 1) + 1
+            s1 = shapes_at(b1)
+            s2 = shapes_at(b2)
+            flags = []
+            for a, b in zip(s1, s2):
+                d1 = a.shape[0] if a.shape else None
+                d2 = b.shape[0] if b.shape else None
+                if d1 == d2:
+                    flags.append(False)
+                elif (d1, d2) == (b1, b2):
+                    flags.append(True)
+                else:
+                    flags.append("affine")
         except Exception:
             flags = None                # fall back to the heuristic
         self._flag_cache[key] = flags
@@ -285,6 +299,11 @@ class Predictor:
         return self
 
     def run(self, inputs: Optional[List[Tensor]] = None):
+        outs = self._run_impl(inputs, block=True)
+        self._last_out = outs[0]
+        return outs
+
+    def _run_impl(self, inputs, block):
         args = inputs if inputs is not None else \
             list(self._inputs.values())
         args = [a if isinstance(a, Tensor) else paddle.to_tensor(a)
@@ -299,52 +318,61 @@ class Predictor:
                     if jnp.issubdtype(a._data.dtype, jnp.floating)
                     else a for a in args]
         buckets = self._config._buckets
-        if buckets and args and args[0].shape[0] > buckets[-1]:
+        flags = self._batch_output_flags(args) if buckets and args \
+            else None
+        # any batch-dependent-but-not-batch output (dim0 = 2B etc.)
+        # cannot be padded-and-trimmed NOR chunked: run unbucketed
+        bucketable = not (flags is not None
+                          and any(f == "affine" for f in flags))
+        if buckets and args and bucketable \
+                and args[0].shape[0] > buckets[-1]:
             # bigger than the top bucket: chunk into top-bucket pieces
             # so the executable count stays bounded by the ladder.
             # Valid only when every output carries the batch — an
-            # aggregate output cannot be reassembled from chunks, so
-            # such models run unbucketed at this size (correctness
-            # over the executable bound).
-            flags = self._batch_output_flags(args)
-            if flags is not None and all(flags):
+            # aggregate output cannot be reassembled from chunks.
+            if flags is not None and all(f is True for f in flags):
                 top = buckets[-1]
                 batch = args[0].shape[0]
                 pieces = []
                 for lo in range(0, batch, top):
                     part = [Tensor._wrap(a._data[lo:lo + top], True)
                             if a.shape[0] == batch else a for a in args]
-                    pieces.append(self.run(part))
+                    # dispatch chunks WITHOUT a per-chunk barrier so
+                    # device work pipelines across them
+                    pieces.append(self._run_impl(part, block=False))
                 outs = [Tensor._wrap(
                     jnp.concatenate([p[i]._data for p in pieces], 0),
                     True) for i in range(len(pieces[0]))]
-                self._last_out = outs[0]
+                if block:
+                    jax.block_until_ready([o._data for o in outs])
                 return outs
-        if self._config._buckets and args:
-            flags = self._batch_output_flags(args)
-        args, true_batch, padded = self._bucketize(args)
+        if bucketable:
+            args, true_batch, padded = self._bucketize(args)
+        else:
+            true_batch = padded = None
         self._ensure_compiled()
         t0 = time.perf_counter()
         with paddle.no_grad():
             out = self._compiled(*args)
         outs = [out] if isinstance(out, Tensor) else list(out)
-        if true_batch:
+        if true_batch is not None:
             # trim ONLY the outputs whose leading dim actually tracks
             # the batch (probed abstractly — a [C] aggregate that
             # happens to equal the padded size must NOT be cut)
             outs = [Tensor._wrap(o._data[:true_batch], True)
-                    if (flags[i] if flags is not None and i < len(flags)
+                    if (flags[i] is True
+                        if flags is not None and i < len(flags)
                         else o._data.ndim >= 1 and o.shape[0] == padded)
                     else o
                     for i, o in enumerate(outs)]
             self.stats["bucket_pad_total"] += 1
-        # latency means device completion, not async dispatch (on the
-        # tunneled backend block_until_ready can ack early; this is
-        # still the closest generic barrier)
-        jax.block_until_ready([o._data for o in outs])
+        if block:
+            # latency means device completion, not async dispatch (on
+            # the tunneled backend block_until_ready can ack early;
+            # this is still the closest generic barrier)
+            jax.block_until_ready([o._data for o in outs])
         self.stats["runs"] += 1
         self.stats["last_latency_ms"] = (time.perf_counter() - t0) * 1e3
-        self._last_out = outs[0]
         return outs
 
     def run_async(self, inputs: Optional[List[Tensor]] = None):
