@@ -155,9 +155,10 @@ def _replay_plan(outputs, inputs):
                 "create_graph=True grad) to differentiate twice")
         return NotImplementedError(
             f"create_graph=True through op '{node.name}' is not "
-            "supported: the node has a custom python backward "
-            "(PyLayer) with no replayable forward. Express the custom "
-            "gradient with paddle_tpu ops, or use the functional "
+            "supported: the node has a custom python backward with no "
+            "replayable forward (PyLayer records one automatically when "
+            "its forward/backward are paddle-op based). Express the "
+            "custom gradient with paddle_tpu ops, or use the functional "
             "jacobian/hessian API")
 
     # iterative post-order DFS over producer nodes, cut at input slots
@@ -423,7 +424,19 @@ class PyLayer:
                 else:
                     edges.append(Edge(leaf=t))
             avals = [(tuple(t.shape), t._data.dtype) for t in out_t]
-            node = GradNode(cls.__name__, vjp_fn, edges, avals)
+            # replayable forward for create_graph=True: a jax.custom_vjp
+            # whose fwd re-runs the user's forward and whose bwd is the
+            # user's backward — when both are built from paddle ops they
+            # are jax-traceable, and reverse-over-reverse through the
+            # (traced) custom bwd gives higher-order grads, matching the
+            # reference's "double grad works if backward is
+            # differentiable" contract for PyLayer.
+            fwd_fn = _pylayer_replay_fn(cls, args, kwargs, diff_inputs,
+                                        single)
+            node = GradNode(cls.__name__, vjp_fn, edges, avals,
+                            fwd_fn=fwd_fn,
+                            in_arrays=tuple(t._data
+                                            for t in diff_inputs))
             import weakref
             for i, t in enumerate(out_t):
                 if id(t) not in nondiff_out_ids:
@@ -432,6 +445,82 @@ class PyLayer:
                     t._out_idx = i
                     node.out_refs[i] = weakref.ref(t)
         return out_list[0] if single else tuple(out_list)
+
+
+def _pylayer_replay_fn(cls, args, kwargs, diff_inputs, single):
+    """Build the jax.custom_vjp replay of one PyLayer application.
+
+    Takes the diff inputs' arrays positionally; every other argument
+    (python values, non-differentiable tensors) is closed over by
+    VALUE. Forward re-runs cls.forward with a fresh ctx (recreating
+    whatever state the user's backward reads); bwd re-runs it again to
+    rebuild the ctx for cls.backward — stage-level rematerialization,
+    the same trade the create_graph replay makes everywhere else."""
+    diff_ids = {id(t): i for i, t in enumerate(diff_inputs)}
+    frozen = [a._data if isinstance(a, Tensor) else a for a in args]
+    frozen_kw = {k: (v._data if isinstance(v, Tensor) else v)
+                 for k, v in kwargs.items()}
+
+    def run_forward(arrays):
+        ctx2 = PyLayerContext()
+        call_args = []
+        for a, f in zip(args, frozen):
+            if isinstance(a, Tensor) and id(a) in diff_ids:
+                call_args.append(
+                    Tensor._wrap(arrays[diff_ids[id(a)]], True))
+            elif isinstance(a, Tensor):
+                call_args.append(Tensor._wrap(f, True))
+            else:
+                call_args.append(a)
+        call_kw = {}
+        for k, v in kwargs.items():
+            if isinstance(v, Tensor) and id(v) in diff_ids:
+                call_kw[k] = Tensor._wrap(arrays[diff_ids[id(v)]], True)
+            elif isinstance(v, Tensor):
+                # snapshot by VALUE: a later optimizer rebind of the
+                # tensor must not leak into the replay
+                call_kw[k] = Tensor._wrap(frozen_kw[k], True)
+            else:
+                call_kw[k] = v
+        with no_grad():
+            outs = cls.forward(ctx2, *call_args, **call_kw)
+        out_list = [outs] if not isinstance(outs, (tuple, list)) \
+            else list(outs)
+        out_arrays = tuple(t._data for t in out_list
+                           if isinstance(t, Tensor))
+        return ctx2, out_arrays
+
+    def raw(*arrays):
+        _, outs = run_forward(arrays)
+        return outs[0] if single else outs
+
+    f = jax.custom_vjp(raw)
+
+    def fwd(*arrays):
+        _, outs = run_forward(arrays)
+        return (outs[0] if single else outs), arrays
+
+    def bwd(res_arrays, cts):
+        ctx2, _ = run_forward(res_arrays)
+        ct_list = [cts] if single else list(cts)
+        ct_tensors = [Tensor._wrap(c, True) for c in ct_list]
+        with no_grad():
+            gin = cls.backward(ctx2, *ct_tensors)
+        gin = [gin] if isinstance(gin, Tensor) or gin is None \
+            else list(gin)
+        grads = []
+        gi = iter(gin)
+        for x in res_arrays:
+            g = next(gi, None)
+            if g is None:
+                grads.append(jnp.zeros(x.shape, x.dtype))
+            else:
+                ga = g._data if isinstance(g, Tensor) else g
+                grads.append(ga.astype(x.dtype))
+        return tuple(grads)
+
+    f.defvjp(fwd, bwd)
+    return f
 
 
 # --------------------------------------------------------------------------

@@ -125,8 +125,6 @@ class Engine:
             valid_data=None):
         """reference Engine.fit: iterate the data source, one compiled
         step per batch, batch sharded over the planned mesh."""
-        import paddle_tpu as paddle
-
         if self._step is None:
             self.prepare(global_batch=batch_size)
         loader = self._as_loader(train_data, batch_size)
@@ -174,8 +172,7 @@ class Engine:
         self.model.eval()
         outs = []
         with paddle.no_grad():
-            for batch in self._as_loader(data, batch_size,
-                                         with_label=False):
+            for batch in self._as_loader(data, batch_size):
                 xb = batch[0] if isinstance(batch, (list, tuple)) \
                     else batch
                 with self._mesh:
@@ -184,13 +181,17 @@ class Engine:
         return outs
 
     # ------------------------------------------------------------ misc
-    def _as_loader(self, data, batch_size, with_label=True):
+    def _as_loader(self, data, batch_size):
         from paddle_tpu.io import DataLoader, Dataset
         if isinstance(data, DataLoader):
             return data
         if isinstance(data, Dataset):
             return DataLoader(data, batch_size=batch_size, shuffle=False)
-        return data                      # any iterable of batches
+        if hasattr(data, "__next__"):
+            # a one-shot iterator/generator would silently train only
+            # epoch 0; materialize it so every epoch sees the batches
+            return list(data)
+        return data                      # any re-iterable of batches
 
     def save(self, path, training=True):
         import paddle_tpu as paddle

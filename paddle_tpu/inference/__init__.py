@@ -204,7 +204,8 @@ class Predictor:
         side inputs (lookup tables, per-position tensors) pass through
         untouched; outputs whose leading dim is the padded batch are
         trimmed back. Returns (args, true_batch, padded_batch);
-        (args, 0, 0) means no padding happened."""
+        (args, None, None) means no padding happened (None, not 0 —
+        a true batch of 0 pads and must still trim)."""
         buckets = self._config._buckets
         if not buckets or not args:
             return args, None, None
@@ -230,8 +231,15 @@ class Predictor:
                      (e.g. 2*B): padding cannot be undone by trimming,
                      so bucketing must be skipped entirely
         None when the model cannot be abstractly evaluated."""
-        key = tuple((tuple(a._data.shape), a._data.dtype.name)
-                    for a in args)
+        # normalize the batch dim out of the key: flags depend only on
+        # WHICH dims track the batch, so arbitrary request sizes reuse
+        # one cache entry instead of re-probing per novel batch size
+        batch0 = args[0].shape[0] if args and args[0]._data.ndim else None
+        key = tuple(
+            (("B",) + tuple(a._data.shape[1:])
+             if a._data.ndim and a.shape[0] == batch0
+             else tuple(a._data.shape), a._data.dtype.name)
+            for a in args)
         if key in getattr(self, "_flag_cache", {}):
             return self._flag_cache[key]
         if not hasattr(self, "_flag_cache"):
@@ -303,7 +311,7 @@ class Predictor:
         self._last_out = outs[0]
         return outs
 
-    def _run_impl(self, inputs, block):
+    def _run_impl(self, inputs, block, record=True):
         args = inputs if inputs is not None else \
             list(self._inputs.values())
         args = [a if isinstance(a, Tensor) else paddle.to_tensor(a)
@@ -333,18 +341,25 @@ class Predictor:
             if flags is not None and all(f is True for f in flags):
                 top = buckets[-1]
                 batch = args[0].shape[0]
+                t0 = time.perf_counter()
                 pieces = []
                 for lo in range(0, batch, top):
                     part = [Tensor._wrap(a._data[lo:lo + top], True)
                             if a.shape[0] == batch else a for a in args]
                     # dispatch chunks WITHOUT a per-chunk barrier so
-                    # device work pipelines across them
-                    pieces.append(self._run_impl(part, block=False))
+                    # device work pipelines across them; inner calls
+                    # don't touch stats — this is ONE user-visible run
+                    pieces.append(self._run_impl(part, block=False,
+                                                 record=False))
                 outs = [Tensor._wrap(
                     jnp.concatenate([p[i]._data for p in pieces], 0),
                     True) for i in range(len(pieces[0]))]
                 if block:
                     jax.block_until_ready([o._data for o in outs])
+                if record:
+                    self.stats["runs"] += 1
+                    self.stats["last_latency_ms"] = \
+                        (time.perf_counter() - t0) * 1e3
                 return outs
         if bucketable:
             args, true_batch, padded = self._bucketize(args)
@@ -371,14 +386,17 @@ class Predictor:
             # the tunneled backend block_until_ready can ack early;
             # this is still the closest generic barrier)
             jax.block_until_ready([o._data for o in outs])
-        self.stats["runs"] += 1
-        self.stats["last_latency_ms"] = (time.perf_counter() - t0) * 1e3
+        if record:
+            self.stats["runs"] += 1
+            self.stats["last_latency_ms"] = \
+                (time.perf_counter() - t0) * 1e3
         return outs
 
     def run_async(self, inputs: Optional[List[Tensor]] = None):
         """Dispatch without blocking (XLA execution is async by
         design); the returned future materializes on .get()."""
-        outs = self.run(inputs)
+        outs = self._run_impl(inputs, block=False)
+        self._last_out = outs[0]
         return _Future(outs)
 
     def get_execution_stats(self):

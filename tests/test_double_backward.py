@@ -222,20 +222,73 @@ def test_duplicate_inputs_share_grad():
     np.testing.assert_allclose(np.asarray(g2._data), [3.0, 12.0])
 
 
-def test_pylayer_create_graph_errors_clearly():
-    class Double(paddle.autograd.PyLayer):
+def test_pylayer_double_backward():
+    """PyLayer supports create_graph=True when its backward is built
+    from paddle ops (the reference's differentiable-backward contract
+    for double grad): y = x^3 via a custom layer whose backward is
+    3 x^2 g — second grad must be 6x."""
+    class Cube(paddle.autograd.PyLayer):
         @staticmethod
         def forward(ctx, x):
-            return x * 2
+            ctx.save_for_backward(x)
+            return x * x * x
 
         @staticmethod
         def backward(ctx, g):
-            return g * 2
+            (x,) = ctx.saved_tensors()
+            return g * 3.0 * x * x
 
-    x = _t([1.0])
-    y = Double.apply(x).sum()
-    with pytest.raises(NotImplementedError, match="PyLayer"):
-        paddle.grad(y, [x], create_graph=True)
+    x = _t([2.0, -1.0])
+    y = Cube.apply(x).sum()
+    (g1,) = paddle.grad(y, [x], create_graph=True)
+    np.testing.assert_allclose(np.asarray(g1._data), [12.0, 3.0])
+    (g2,) = paddle.grad(g1.sum(), [x])
+    np.testing.assert_allclose(np.asarray(g2._data), [12.0, -6.0])
+
+
+def test_pylayer_double_backward_custom_grad_respected():
+    """The replay must use the USER backward, not autodiff of the
+    forward: a layer whose backward deliberately scales grads by 10."""
+    class Scaled(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x
+
+        @staticmethod
+        def backward(ctx, g):
+            (x,) = ctx.saved_tensors()
+            return g * 2.0 * x * 10.0       # 10x the true grad
+
+    x = _t([3.0])
+    y = Scaled.apply(x).sum()
+    (g1,) = paddle.grad(y, [x], create_graph=True)
+    np.testing.assert_allclose(np.asarray(g1._data), [60.0])
+    (g2,) = paddle.grad(g1.sum(), [x])
+    np.testing.assert_allclose(np.asarray(g2._data), [20.0])
+
+
+def test_pylayer_gradient_penalty_through_network():
+    """A PyLayer inside a small net, WGAN-GP style second backward."""
+    class LeakyAbs(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return paddle.abs(x)
+
+        @staticmethod
+        def backward(ctx, g):
+            (x,) = ctx.saved_tensors()
+            return g * paddle.tanh(10.0 * x)  # smooth custom sign
+
+    rng = np.random.RandomState(3)
+    x = _t(rng.randn(4, 6))
+    w = _t(rng.randn(6, 1))
+    d = LeakyAbs.apply(paddle.matmul(x, w)).sum()
+    (gx,) = paddle.grad(d, [x], create_graph=True)
+    gp = (gx ** 2.0).sum()
+    gp.backward()
+    assert w.grad is not None and np.isfinite(w.grad.numpy()).all()
 
 
 def test_released_graph_errors_clearly():
