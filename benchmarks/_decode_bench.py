@@ -1,0 +1,60 @@
+"""Decode tokens/s probe for the static-cache serving path.
+
+Run on the real chip: `python benchmarks/_decode_bench.py [size]`
+size: tiny (default, CPU-safe) | 1.3b (GPT-1.3B-shaped, needs TPU HBM)
+
+Reports prefill latency, per-token decode latency and tokens/s, and the
+executable counts (must be 1 prefill + 1 decode after warmup).
+"""
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def main():
+    size = sys.argv[1] if len(sys.argv) > 1 else "tiny"
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.inference.decode import DecodeSession
+
+    paddle.seed(0)
+    if size == "1.3b":
+        cfg = GPTConfig.gpt3_1p3b()
+        B, S, new, cap = 8, 128, 128, 512
+    else:
+        cfg = GPTConfig.tiny()
+        B, S, new, cap = 4, 16, 32, 64
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    if size == "1.3b":
+        # serve in bf16 (the deployment precision)
+        import jax.numpy as jnp
+        for _, p in m.named_parameters():
+            if jnp.issubdtype(p._data.dtype, jnp.floating):
+                p._assign_array(p._data.astype(jnp.bfloat16))
+
+    sess = DecodeSession(m, cap)
+    ids = paddle.randint(0, cfg.vocab_size, [B, S])
+
+    t0 = time.perf_counter()
+    out = sess.generate(ids, max_new_tokens=4)
+    jax.block_until_ready(out._data)
+    warm = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    out = sess.generate(ids, max_new_tokens=new)
+    jax.block_until_ready(out._data)
+    dt = time.perf_counter() - t0
+
+    n_tok = B * new
+    print(f"model={size} B={B} S={S} new={new} cap={cap}")
+    print(f"warmup(compile): {warm:.2f}s")
+    print(f"generate: {dt*1e3:.1f}ms  "
+          f"{n_tok/dt:.1f} tok/s  {dt/new*1e3:.2f} ms/step")
+    print(f"executables (prefill, decode): {sess.executable_counts()}")
+
+
+if __name__ == "__main__":
+    main()

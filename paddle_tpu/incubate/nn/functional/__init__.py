@@ -174,10 +174,52 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn1_bias, ffn2_weight,
                   ffn2_weight, ffn2_bias)
 
 
-def masked_multihead_attention(x, cache_kv=None, **kw):
-    raise NotImplementedError(
-        "decode-path masked_multihead_attention: use the KV-cache path in "
-        "paddle_tpu.models.llama (LlamaModel with caches)")
+def masked_multihead_attention(x, cache_kv=None, src_mask=None,
+                               sequence_lengths=None, rotary_tensor=None,
+                               beam_cache_offset=None, out_shift=None,
+                               out_smooth=None, seq_len=1, rotary_emb_dims=0,
+                               use_neox_rotary_style=False,
+                               compute_dtype='default', out_scale=-1,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0):
+    """Reference masked_multihead_attention.py — the single-token decode
+    attention kernel. TPU-native: the paged GPU kernel becomes a
+    static-shape program over a fixed-capacity cache (write via
+    dynamic_update_slice + length-masked attention); see
+    paddle_tpu/inference/decode.py for the full serving path.
+
+    x: [B, 3*H*D] fused qkv for the current step; cache_kv:
+    [2, B, H, max_seq, D]; sequence_lengths: [B] int32 (current lengths;
+    defaults to full cache if omitted is not supported — pass lengths).
+    Returns (out [B, H*D], new_cache_kv) (+ beam offset passthrough).
+    """
+    from paddle_tpu.inference.decode import masked_multihead_attention_impl
+    if cache_kv is None:
+        raise ValueError("masked_multihead_attention requires cache_kv "
+                         "[2, B, num_heads, max_seq_len, head_dim]")
+    if sequence_lengths is None:
+        raise ValueError(
+            "pass sequence_lengths [B] int32: on TPU the cache is a "
+            "fixed-capacity buffer, so valid lengths are explicit")
+    if rotary_tensor is not None or use_neox_rotary_style:
+        raise NotImplementedError(
+            "custom rotary_tensor / neox-style rotary are not supported: "
+            "only interleaved theta=1e4 RoPE (rotary_emb_dims>0) is "
+            "implemented — apply custom rotary to x before the call")
+    if src_mask is not None:
+        raise NotImplementedError(
+            "src_mask is not supported on the TPU decode path: causality "
+            "comes from the cache length mask (mask lengths via "
+            "sequence_lengths instead)")
+    num_heads = cache_kv.shape[2]
+    theta = None
+    if rotary_emb_dims and rotary_emb_dims > 0:
+        theta = 10000.0
+    out, new_cache = masked_multihead_attention_impl(
+        x, cache_kv, sequence_lengths, num_heads, rotary_theta=theta)
+    if beam_cache_offset is not None:
+        return out, new_cache, beam_cache_offset
+    return out, new_cache
 
 
 def variable_length_memory_efficient_attention(query, key, value,

@@ -46,6 +46,7 @@ class Config:
         self._precision = None          # None | bf16/fp16 jnp dtype
         self._int8_weights = False
         self._buckets: Optional[List[int]] = None
+        self._decode: Optional[dict] = None
 
     # ---- reference-config surface (XLA-internal knobs are no-ops) ----
     def enable_use_gpu(self, *a, **k):
@@ -86,6 +87,17 @@ class Config:
         int8-native export — HBM savings come from shipping that
         payload, not from this in-memory emulation."""
         self._int8_weights = bool(flag)
+        return self
+
+    def enable_decode(self, max_length: int, prefill_buckets=None,
+                      temperature=0.0, top_p=None, eos_token_id=None):
+        """Serving decode config: fixed-capacity KV cache of
+        `max_length`, prefill compiled per bucket, one compiled decode
+        step (see inference/decode.py). Enables Predictor.generate."""
+        self._decode = dict(max_length=int(max_length),
+                            prefill_buckets=prefill_buckets,
+                            temperature=temperature, top_p=top_p,
+                            eos_token_id=eos_token_id)
         return self
 
     def enable_shape_bucketing(self, buckets: Sequence[int]):
@@ -392,6 +404,29 @@ class Predictor:
                 (time.perf_counter() - t0) * 1e3
         return outs
 
+    def generate(self, input_ids, max_new_tokens=16, seed=0):
+        """Serving generation over the fixed-capacity KV cache: needs
+        Config.enable_decode and a layer implementing the
+        init_cache/forward_with_cache contract (models/llama.py,
+        models/gpt.py). ONE decode executable for all tokens."""
+        if self._config._decode is None:
+            raise RuntimeError("call Config.enable_decode(max_length) "
+                               "before Predictor.generate")
+        if not hasattr(self._layer, "forward_with_cache"):
+            raise TypeError(
+                "the served layer does not expose the decode contract "
+                "(init_cache + forward_with_cache)")
+        if getattr(self, "_decode_session", None) is None:
+            from .decode import DecodeSession
+            self._decode_session = DecodeSession(self._layer,
+                                                 **self._config._decode)
+        t0 = time.perf_counter()
+        out = self._decode_session.generate(input_ids, max_new_tokens,
+                                            seed=seed)
+        self.stats["runs"] += 1
+        self.stats["last_latency_ms"] = (time.perf_counter() - t0) * 1e3
+        return out
+
     def run_async(self, inputs: Optional[List[Tensor]] = None):
         """Dispatch without blocking (XLA execution is async by
         design); the returned future materializes on .get()."""
@@ -469,3 +504,119 @@ class _Handle:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+# ---------------------------------------------------------------------
+# Int8-native serving (consumes the _int8_payload the PTQ pass records)
+# ---------------------------------------------------------------------
+
+class Int8Linear(paddle.nn.Layer):
+    """Weight-only-int8 serving Linear: HBM holds the int8 payload +
+    per-output-channel scales; dequantization happens INSIDE the
+    compiled program at the matmul edge, where XLA fuses it into the
+    GEMM read (the int8->bf16 convert rides the HBM->MXU path). This is
+    the deployable form of the PTQ weight-only pass — reference:
+    the int8 weight-only path of analysis_predictor's quant passes."""
+
+    def __init__(self, weight_q, weight_scale, bias=None,
+                 compute_dtype="float32"):
+        super().__init__()
+        from paddle_tpu.core import dtype as dtype_mod
+        self._compute_dtype = dtype_mod.convert_dtype(compute_dtype)
+        wq = weight_q if isinstance(weight_q, Tensor) else \
+            Tensor(np.asarray(weight_q, np.int8))
+        sc = weight_scale if isinstance(weight_scale, Tensor) else \
+            Tensor(np.asarray(weight_scale, np.float32))
+        self.register_buffer("weight_q", wq)
+        self.register_buffer("weight_scale", sc)
+        self.bias = None
+        if bias is not None:
+            self.bias = bias if isinstance(bias, Tensor) else Tensor(bias)
+
+    def forward(self, x):
+        from paddle_tpu.core.dispatch import run_op
+
+        def f(a, wq, sc, *rest):
+            w = wq.astype(self._compute_dtype) * sc.reshape(1, -1)
+            out = a.astype(self._compute_dtype) @ w
+            if rest:
+                out = out + rest[0]
+            return out
+        args = [x, self.weight_q, self.weight_scale]
+        if self.bias is not None:
+            args.append(self.bias)
+        return run_op("int8_linear", f, *args, differentiable=False)
+
+
+def apply_int8_rewrite(layer, compute_dtype="float32"):
+    """Swap every Linear carrying an _int8_payload for an Int8Linear
+    holding the int8 buffer natively. Returns the count swapped."""
+    from paddle_tpu.nn.layer.common import Linear as _Linear
+    n = 0
+    for name, sub in list(layer._sub_layers.items()):
+        if isinstance(sub, _Linear) and \
+                getattr(sub.weight, "_int8_payload", None) is not None:
+            q, scale = sub.weight._int8_payload
+            layer._sub_layers[name] = Int8Linear(
+                Tensor(np.asarray(q, np.int8)),
+                Tensor(np.asarray(scale, np.float32).reshape(-1)),
+                bias=sub.bias, compute_dtype=compute_dtype)
+            n += 1
+        else:
+            n += apply_int8_rewrite(sub, compute_dtype)
+    return n
+
+
+def save_int8_model(predictor: Predictor, path: str):
+    """Write the int8-native serving artifact: one npz holding each
+    quantized Linear's (int8 payload, scales) plus every other state
+    tensor in fp. Load with `load_int8_model(layer, path)`."""
+    layer = predictor._layer
+    if not predictor._config._int8_weights:
+        raise ValueError("enable_int8_weight_only() first: the int8 "
+                         "payload is recorded by that pass")
+    entries = {}
+    for name, p in layer.named_parameters():
+        payload = getattr(p, "_int8_payload", None)
+        if payload is not None:
+            q, scale = payload
+            entries[name + ".int8"] = np.asarray(q, np.int8)
+            entries[name + ".scale"] = np.asarray(scale,
+                                                  np.float32).reshape(-1)
+        else:
+            entries[name] = np.asarray(p._data)
+    for name, b in layer.named_buffers():
+        entries["buffer:" + name] = np.asarray(b._data)
+    np.savez(path, **entries)
+
+
+def load_int8_model(layer, path: str, compute_dtype="float32"):
+    """Restore an int8 serving artifact into a freshly-built layer:
+    quantized Linears are swapped to Int8Linear (int8 stays int8 in
+    HBM), everything else is loaded as saved."""
+    data = np.load(path if path.endswith(".npz") else path + ".npz")
+    int8_weights = {k[:-len(".int8")]: data[k] for k in data.files
+                    if k.endswith(".int8")}
+    scales = {k[:-len(".scale")]: data[k] for k in data.files
+              if k.endswith(".scale")}
+    for name, p in layer.named_parameters():
+        if name in int8_weights:
+            # restore QDQ numerics for every quantized param; Linear
+            # weights are then swapped to int8-native storage below
+            # (non-Linear quantized params, e.g. embeddings, serve the
+            # dequantized values — same numerics, fp storage)
+            q, sc = int8_weights[name], scales[name]
+            ax = -1 if q.ndim == 2 else 0
+            shape = [1] * q.ndim
+            shape[ax % q.ndim] = -1
+            deq = q.astype(np.float32) * sc.reshape(shape)
+            p._assign_array(jnp.asarray(deq, np.asarray(p._data).dtype))
+            p._int8_payload = (q, sc)
+        elif name in data.files:
+            p._assign_array(jnp.asarray(data[name]))
+    for name, b in layer.named_buffers():
+        key = "buffer:" + name
+        if key in data.files:
+            b._assign_array(jnp.asarray(data[key]))
+    apply_int8_rewrite(layer, compute_dtype)
+    return layer

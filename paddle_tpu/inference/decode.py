@@ -1,0 +1,348 @@
+"""TPU-native decode/serving path: static KV cache + one compiled step.
+
+Reference being reproduced:
+  * masked_multihead_attention decode kernel
+    (/root/reference/python/paddle/incubate/nn/functional/masked_multihead_attention.py)
+  * block_multihead_attention paged-KV serving attention
+    (/root/reference/python/paddle/incubate/nn/functional/block_multihead_attention.py)
+  * the serving role of AnalysisPredictor
+    (/root/reference/paddle/fluid/inference/api/analysis_predictor.h:105)
+
+TPU-native design. GPU serving pages the KV cache because CUDA kernels can
+chase block tables; on TPU every program is compiled with static shapes, so
+the idiomatic equivalent is a FIXED-CAPACITY dense cache ``[B, C, Hkv, D]``
+plus a per-sequence length counter:
+
+  * the cache is updated in place with ``lax.dynamic_update_slice`` — XLA
+    aliases the donated buffer, so this is a true in-place write in HBM;
+  * attention masks columns ``>= length``, so capacity padding never leaks;
+  * ONE jitted decode step (embed -> attention against the cache prefix ->
+    sample) is reused for every generated token — zero recompiles after
+    warmup;
+  * prefill runs as a second static program per bucketed prompt length.
+
+`DecodeSession` packages this: it traces the model's cached forward into
+pure jax functions (weights passed as inputs, cache donated), and exposes
+``generate``.
+"""
+from __future__ import annotations
+
+import collections
+import math
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.core.dispatch import run_op
+
+# Per-layer fixed-capacity cache. k/v: [B, C, num_kv_heads, head_dim];
+# length: [B] int32 — number of valid positions per sequence.
+StaticCache = collections.namedtuple("StaticCache", ["k", "v", "length"])
+
+
+def init_static_cache(batch_size, capacity, num_kv_heads, head_dim,
+                      dtype="float32"):
+    """Allocate one layer's fixed-capacity KV cache."""
+    from paddle_tpu.ops.creation import zeros
+    k = zeros([batch_size, capacity, num_kv_heads, head_dim], dtype=dtype)
+    v = zeros([batch_size, capacity, num_kv_heads, head_dim], dtype=dtype)
+    length = zeros([batch_size], dtype="int32")
+    return StaticCache(k, v, length)
+
+
+def _write_kv(buf, new, lens):
+    """Write new [B, s, H, D] into buf [B, C, H, D] at per-seq offsets."""
+    return jax.vmap(
+        lambda b, n, l: lax.dynamic_update_slice(b, n, (l, 0, 0))
+    )(buf, new, lens)
+
+
+def _cache_attention(q, kn, vn, kbuf, vbuf, lens):
+    """Write-then-attend against a fixed-capacity cache.
+
+    q: [B, s, H, D] new queries; kn/vn: [B, s, Hkv, D] new keys/values;
+    kbuf/vbuf: [B, C, Hkv, D]; lens: [B] valid lengths BEFORE this call.
+    Returns (out [B, s, H, D], kbuf', vbuf', lens + s). GQA is handled by
+    grouping the query heads — the cache is never materialized at H heads.
+    """
+    b, s, h, d = q.shape
+    c = kbuf.shape[1]
+    hkv = kbuf.shape[2]
+    kbuf = _write_kv(kbuf, kn.astype(kbuf.dtype), lens)
+    vbuf = _write_kv(vbuf, vn.astype(vbuf.dtype), lens)
+    g = h // hkv
+    qg = q.reshape(b, s, hkv, g, d).astype(jnp.float32)
+    scale = 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bskgd,bckd->bkgsc", qg,
+                        kbuf.astype(jnp.float32)) * scale
+    col = jnp.arange(c)[None, None, None, None, :]
+    row = jnp.arange(s)[None, None, None, :, None]
+    valid = col < (lens[:, None, None, None, None] + row + 1)
+    logits = jnp.where(valid, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgsc,bckd->bskgd", probs,
+                     vbuf.astype(jnp.float32))
+    return (out.reshape(b, s, h, d).astype(q.dtype), kbuf, vbuf,
+            lens + jnp.int32(s))
+
+
+def cache_attention(q, k_new, v_new, cache: StaticCache):
+    """Eager-op wrapper: attend q against (cache ++ new kv), updating the
+    cache in place. Returns (out, new_cache). Not differentiable (serving
+    path)."""
+    out, k2, v2, l2 = run_op(
+        "masked_cache_attention", _cache_attention, q, k_new, v_new,
+        cache.k, cache.v, cache.length, n_outputs=4, differentiable=False)
+    return out, StaticCache(k2, v2, l2)
+
+
+def masked_multihead_attention_impl(x, cache_kv, seq_lens, num_heads,
+                                    rotary_theta: Optional[float] = None):
+    """Reference masked_multihead_attention semantics on the static cache.
+
+    x: [B, 3*H*D] fused qkv for ONE decode step; cache_kv: [2, B, H, C, D]
+    (the reference's cache layout); seq_lens: [B] int32 lengths before this
+    step. Returns (out [B, H*D], new cache_kv).
+    """
+    def f(xa, ck, lens):
+        b = xa.shape[0]
+        h = num_heads
+        d = xa.shape[1] // (3 * h)
+        qkv = xa.reshape(b, 3, h, d)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]     # [B, H, D]
+        if rotary_theta is not None:
+            pos = lens.astype(jnp.float32)            # [B]
+            inv = 1.0 / (rotary_theta ** (
+                jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+            freqs = pos[:, None] * inv[None, :]       # [B, D/2]
+            cos = jnp.cos(freqs)[:, None, :]
+            sin = jnp.sin(freqs)[:, None, :]
+
+            def rot(a):
+                a1, a2 = a[..., 0::2], a[..., 1::2]
+                o1 = a1 * cos - a2 * sin
+                o2 = a2 * cos + a1 * sin
+                return jnp.stack([o1, o2], -1).reshape(a.shape)
+            q, k = rot(q), rot(k)
+        # cache layout [2, B, H, C, D] -> our [B, C, H, D]
+        kbuf = jnp.swapaxes(ck[0], 1, 2)
+        vbuf = jnp.swapaxes(ck[1], 1, 2)
+        out, kbuf, vbuf, _ = _cache_attention(
+            q[:, None], k[:, None], v[:, None], kbuf, vbuf, lens)
+        new_ck = jnp.stack([jnp.swapaxes(kbuf, 1, 2),
+                            jnp.swapaxes(vbuf, 1, 2)])
+        return out.reshape(b, h * d), new_ck
+    return run_op("masked_multihead_attention", f, x, cache_kv, seq_lens,
+                  n_outputs=2, differentiable=False)
+
+
+def _sample(logits, key, temperature, top_p):
+    """On-device sampling: greedy / temperature / nucleus."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), key
+    probs = jax.nn.softmax(logits.astype(jnp.float32) / temperature, -1)
+    if top_p is not None and top_p < 1.0:
+        sorted_p = jnp.sort(probs, axis=-1)[..., ::-1]
+        cum = jnp.cumsum(sorted_p, axis=-1)
+        # smallest set whose mass exceeds top_p: keep p >= threshold
+        k = jnp.sum(cum - sorted_p < top_p, axis=-1, keepdims=True)
+        thresh = jnp.take_along_axis(sorted_p, k - 1, axis=-1)
+        probs = jnp.where(probs >= thresh, probs, 0.0)
+        probs = probs / jnp.sum(probs, -1, keepdims=True)
+    key, sub = jax.random.split(key)
+    nxt = jax.random.categorical(sub, jnp.log(jnp.maximum(probs, 1e-30)))
+    return nxt.astype(jnp.int32), key
+
+
+def _default_buckets(max_length):
+    b, out = 16, []
+    while b < max_length:
+        out.append(b)
+        b *= 2
+    out.append(max_length)
+    return out
+
+
+class DecodeSession:
+    """Compiled serving session over a causal-LM Layer.
+
+    The model must implement ``init_cache(batch_size, max_length=C)`` ->
+    list[StaticCache] and ``forward_with_cache(ids, caches)`` ->
+    (logits, caches); `LlamaForCausalLM` / `GPTForCausalLM` do.
+
+    Two executables total (plus one prefill per prompt bucket): cache
+    buffers are donated to the decode step so generation runs at a single
+    cache's HBM footprint with zero recompiles after warmup.
+    """
+
+    def __init__(self, model, max_length, prefill_buckets=None,
+                 temperature=0.0, top_p=None, eos_token_id=None):
+        model.eval()
+        self._model = model
+        self._max_length = int(max_length)
+        self._buckets = sorted(prefill_buckets or
+                               _default_buckets(self._max_length))
+        self._temperature = float(temperature)
+        self._top_p = top_p
+        self._eos = eos_token_id
+        self._buckets = [min(b, self._max_length) for b in self._buckets]
+        self._state = self._collect_state()
+        # one jitted decode step; cache buffers donated (decode args are
+        # (*state, token, key, *cache_leaves) -> caches start at n+2)
+        n_state = len(self._state)
+        self._decode_jit = jax.jit(
+            self._decode_pure,
+            donate_argnums=tuple(range(n_state + 2,
+                                       n_state + 2 + self._n_cache_leaves)))
+        self._prefill_jit = jax.jit(self._prefill_pure)
+
+    # -- state plumbing (same discipline as jit.StaticFunction) ---------
+    def _collect_state(self):
+        out, seen = [], set()
+        for _, p in self._model.named_parameters():
+            if id(p) not in seen:
+                seen.add(id(p))
+                out.append(p)
+        for _, b in self._model.named_buffers():
+            if id(b) not in seen:
+                seen.add(id(b))
+                out.append(b)
+        return out
+
+    @property
+    def _n_cache_leaves(self):
+        if not hasattr(self, "_cache_leaves_n"):
+            c = self._model.init_cache(1, max_length=8)
+            self._cache_leaves_n = len(jax.tree_util.tree_leaves(
+                [tuple(x._data for x in layer) for layer in c]))
+        return self._cache_leaves_n
+
+    def _run_model(self, state_arrays, ids_arr, cache_arrays):
+        """Rebind traced state into the live model and run its cached
+        forward (the jit.StaticFunction discipline, serving-only)."""
+        import paddle_tpu as paddle
+        state = self._state
+        saved = [t._data for t in state]
+        try:
+            for t, a in zip(state, state_arrays):
+                t._data = a
+            caches = jax.tree_util.tree_unflatten(
+                self._cache_treedef,
+                [Tensor._wrap(a, True) for a in cache_arrays])
+            caches = [StaticCache(*c) for c in caches]
+            with paddle.no_grad():
+                logits, caches = self._model.forward_with_cache(
+                    Tensor._wrap(ids_arr, True), caches)
+            cache_out = [a._data for a in jax.tree_util.tree_leaves(
+                [tuple(c) for c in caches],
+                is_leaf=lambda x: isinstance(x, Tensor))]
+            return logits._data, cache_out
+        finally:
+            for t, s in zip(state, saved):
+                t._data = s
+
+    def _prefill_pure(self, *flat):
+        n = len(self._state)
+        state, (ids, lens, key) = flat[:n], flat[n:n + 3]
+        cache_arrays = flat[n + 3:]
+        logits, cache_out = self._run_model(state, ids, cache_arrays)
+        # last VALID position's logits, per sequence
+        b = ids.shape[0]
+        last = logits[jnp.arange(b), lens - 1]
+        nxt, key = _sample(last, key, self._temperature, self._top_p)
+        # prefill wrote the full padded block: reset lengths to the true
+        # prompt lengths (padding slots get overwritten by decode steps).
+        # The length leaf is located structurally via the cache treedef,
+        # not sniffed by dtype.
+        layers = jax.tree_util.tree_unflatten(self._cache_treedef,
+                                              cache_out)
+        layers = [(k, v, lens) for (k, v, _l) in layers]
+        cache_out = jax.tree_util.tree_leaves(layers)
+        return nxt, key, cache_out
+
+    def _decode_pure(self, *flat):
+        n = len(self._state)
+        state, token, key = flat[:n], flat[n], flat[n + 1]
+        cache_arrays = flat[n + 2:]
+        logits, cache_out = self._run_model(state, token[:, None],
+                                            cache_arrays)
+        nxt, key = _sample(logits[:, -1], key, self._temperature,
+                           self._top_p)
+        return nxt, key, cache_out
+
+    # -- public API -----------------------------------------------------
+    def generate(self, input_ids, max_new_tokens=16, seed=0):
+        """Generate tokens; returns [B, prompt + n_generated] ids."""
+        ids = input_ids._data if isinstance(input_ids, Tensor) else \
+            jnp.asarray(input_ids)
+        ids = ids.astype(jnp.int32)
+        b, s = ids.shape
+        # every generated token except the last is written into the
+        # cache, so occupancy reaches s + max_new_tokens - 1
+        if s + max_new_tokens - 1 > self._max_length:
+            raise ValueError(
+                f"prompt ({s}) + {max_new_tokens} new tokens exceeds the "
+                f"cache capacity max_length={self._max_length}")
+        bucket = next((k for k in self._buckets if k >= s),
+                      self._max_length)
+        padded = jnp.pad(ids, ((0, 0), (0, bucket - s)))
+        lens = jnp.full((b,), s, jnp.int32)
+        caches = self._model.init_cache(b, max_length=self._max_length)
+        self._cache_treedef = jax.tree_util.tree_structure(
+            [tuple(c) for c in caches])
+        cache_arrays = [x._data for c in caches for x in c]
+        state = [t._data for t in self._state]
+        key = jax.random.PRNGKey(seed)
+
+        token, key, cache_arrays = self._prefill_jit(
+            *state, padded, lens, key, *cache_arrays)
+        outs = [token]
+        for _ in range(max_new_tokens - 1):
+            token, key, cache_arrays = self._decode_jit(
+                *state, token, key, *cache_arrays)
+            outs.append(token)
+            if self._eos is not None and bool(
+                    jnp.all(token == self._eos)):
+                break
+        gen = jnp.stack(outs, axis=1)
+        return Tensor._wrap(jnp.concatenate([ids, gen], axis=1), True)
+
+    def executable_counts(self):
+        """(n_prefill_executables, n_decode_executables) — the decode
+        count must stay 1 however many tokens are generated."""
+        return (self._prefill_jit._cache_size(),
+                self._decode_jit._cache_size())
+
+
+def cached_generate(model, input_ids, max_new_tokens=16, temperature=0.0,
+                    top_p=None, seed=0, max_length=None, seq_ceiling=None,
+                    hard_limit=False):
+    """Shared model.generate() implementation: pick a cache capacity
+    (next power of two covering prompt+new, floored at 64), cache one
+    DecodeSession per (capacity, sampling config) on the model, and
+    generate.
+
+    seq_ceiling: the model's positional limit. With hard_limit=True
+    (learned position tables — GPT's wpe) requests past the ceiling
+    raise; with hard_limit=False (RoPE — llama) the ceiling is only a
+    sizing hint and longer requests are allowed.
+    """
+    need = input_ids.shape[1] + max_new_tokens
+    if hard_limit and seq_ceiling is not None and need > seq_ceiling:
+        raise ValueError(
+            f"prompt + max_new_tokens = {need} exceeds the model's "
+            f"positional table ({seq_ceiling})")
+    ceil_eff = seq_ceiling if (hard_limit and seq_ceiling) else \
+        max(seq_ceiling or 0, need)
+    cap = max_length or min(max(64, 1 << (need - 1).bit_length()),
+                            ceil_eff)
+    key = (cap, float(temperature), top_p)
+    sessions = model.__dict__.setdefault("_decode_sessions", {})
+    if key not in sessions:
+        sessions[key] = DecodeSession(model, cap, temperature=temperature,
+                                      top_p=top_p)
+    return sessions[key].generate(input_ids, max_new_tokens, seed=seed)

@@ -53,11 +53,18 @@ class GPTAttention(nn.Layer):
             self.proj = nn.Linear(h, h)
         self.drop = nn.Dropout(cfg.dropout)
 
-    def forward(self, x):
+    def forward(self, x, cache=None):
         b, s, h = x.shape
         nh = self.cfg.num_heads
         qkv = self.qkv(x).reshape([b, s, 3, nh, h // nh])
         q, k, v = qkv.unbind(axis=2)
+        if cache is not None:
+            # fixed-capacity decode path (inference/decode.py): write
+            # k/v at the cache lengths, attend with the length mask
+            from paddle_tpu.inference.decode import cache_attention
+            out, cache = cache_attention(q, k, v, cache)
+            out = out.reshape([b, s, h])
+            return self.drop(self.proj(out)), cache
         out = F.scaled_dot_product_attention(
             q, k, v, is_causal=True,
             dropout_p=self.cfg.dropout, training=self.training)
@@ -90,7 +97,12 @@ class GPTBlock(nn.Layer):
         self.ln2 = nn.LayerNorm(cfg.hidden_size)
         self.mlp = GPTMLP(cfg)
 
-    def forward(self, x):
+    def forward(self, x, cache=None):
+        if cache is not None:
+            a, cache = self.attn(self.ln1(x), cache)
+            x = x + a
+            x = x + self.mlp(self.ln2(x))
+            return x, cache
         x = x + self.attn(self.ln1(x))
         x = x + self.mlp(self.ln2(x))
         return x
@@ -115,19 +127,39 @@ class GPTModel(nn.Layer):
             self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
                                      bias_attr=False)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None):
         b, s = input_ids.shape
-        pos = paddle.arange(s, dtype="int64").unsqueeze(0)
+        if caches is not None:
+            # learned positions continue from the per-sequence cache
+            # lengths (all layer caches share one length counter)
+            pos = caches[0].length.unsqueeze(1) + \
+                paddle.arange(s, dtype="int64").unsqueeze(0)
+        else:
+            pos = paddle.arange(s, dtype="int64").unsqueeze(0)
         x = self.wte(input_ids) + self.wpe(pos)
         x = self.drop(x)
-        for blk in self.blocks:
-            x = blk(x)
+        if caches is not None:
+            new_caches = []
+            for blk, c in zip(self.blocks, caches):
+                x, c = blk(x, c)
+                new_caches.append(c)
+            caches = new_caches
+        else:
+            for blk in self.blocks:
+                x = blk(x)
         x = self.ln_f(x)
         if self.cfg.tie_embeddings:
             logits = paddle.matmul(x, self.wte.weight, transpose_y=True)
         else:
             logits = self.lm_head(x)
-        return logits
+        return logits if caches is None else (logits, caches)
+
+    def init_cache(self, batch_size, max_length):
+        from paddle_tpu.inference.decode import init_static_cache
+        d = self.cfg.hidden_size // self.cfg.num_heads
+        return [init_static_cache(batch_size, max_length,
+                                  self.cfg.num_heads, d)
+                for _ in range(self.cfg.num_layers)]
 
 
 class GPTForCausalLM(nn.Layer):
@@ -144,3 +176,25 @@ class GPTForCausalLM(nn.Layer):
             logits[:, :-1].reshape([-1, logits.shape[-1]]),
             labels[:, 1:].reshape([-1]))
         return loss
+
+    def init_cache(self, batch_size, max_length=None):
+        return self.gpt.init_cache(batch_size, max_length or
+                                   self.gpt.cfg.max_seq_len)
+
+    def forward_with_cache(self, input_ids, caches):
+        """DecodeSession contract: (ids, caches) -> (logits, caches)."""
+        return self.gpt(input_ids, caches)
+
+    @paddle.no_grad()
+    def generate(self, input_ids, max_new_tokens=16, temperature=0.0,
+                 top_p=None, seed=0, max_length=None):
+        """Compiled static-shape generation over the fixed-capacity KV
+        cache (see inference/decode.py)."""
+        from paddle_tpu.inference.decode import cached_generate
+        self.eval()
+        # learned wpe table: positions past max_seq_len are a hard error
+        return cached_generate(self, input_ids, max_new_tokens,
+                               temperature=temperature, top_p=top_p,
+                               seed=seed, max_length=max_length,
+                               seq_ceiling=self.gpt.cfg.max_seq_len,
+                               hard_limit=True)

@@ -45,22 +45,24 @@ class LlamaConfig:
 
 def apply_rotary_pos_emb(x, position_offset=0, theta=10000.0):
     """RoPE on [B, S, H, D] (reference:
-    incubate/nn/functional/fused_rotary_position_embedding.py)."""
-    def f(a):
+    incubate/nn/functional/fused_rotary_position_embedding.py).
+    position_offset may be a python int or a [B] int32 tensor (the decode
+    path's per-sequence cache lengths)."""
+    def f(a, off):
         b, s, h, d = a.shape
-        pos = jnp.arange(position_offset, position_offset + s,
-                         dtype=jnp.float32)
+        pos = (off.reshape(-1, 1).astype(jnp.float32)
+               + jnp.arange(s, dtype=jnp.float32)[None, :])   # [B|1, S]
         inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-        freqs = jnp.outer(pos, inv)                    # [S, D/2]
-        cos = jnp.cos(freqs)[None, :, None, :]
-        sin = jnp.sin(freqs)[None, :, None, :]
+        freqs = pos[..., None] * inv                   # [B|1, S, D/2]
+        cos = jnp.cos(freqs)[:, :, None, :]
+        sin = jnp.sin(freqs)[:, :, None, :]
         x1 = a[..., 0::2].astype(jnp.float32)
         x2 = a[..., 1::2].astype(jnp.float32)
         o1 = x1 * cos - x2 * sin
         o2 = x2 * cos + x1 * sin
         out = jnp.stack([o1, o2], axis=-1).reshape(a.shape)
         return out.astype(a.dtype)
-    return run_op("rope", f, x)
+    return run_op("rope", f, x, position_offset)
 
 
 class LlamaAttention(nn.Layer):
@@ -86,12 +88,22 @@ class LlamaAttention(nn.Layer):
             self.o_proj = nn.Linear(h, h, bias_attr=False)
 
     def forward(self, x, position_offset=0, cache=None):
+        from paddle_tpu.inference.decode import StaticCache, cache_attention
         cfg = self.cfg
         b, s, h = x.shape
         d = h // cfg.num_heads
         q = self.q_proj(x).reshape([b, s, cfg.num_heads, d])
         k = self.k_proj(x).reshape([b, s, cfg.num_kv_heads, d])
         v = self.v_proj(x).reshape([b, s, cfg.num_kv_heads, d])
+        if isinstance(cache, StaticCache):
+            # fixed-capacity decode path: RoPE offsets come from the
+            # per-sequence cache lengths; ONE static-shape program per
+            # (B, s) — no recompiles, no reallocating concat
+            q = apply_rotary_pos_emb(q, cache.length, cfg.rope_theta)
+            k = apply_rotary_pos_emb(k, cache.length, cfg.rope_theta)
+            out, cache = cache_attention(q, k, v, cache)
+            out = out.reshape([b, s, h])
+            return self.o_proj(out), cache
         q = apply_rotary_pos_emb(q, position_offset, cfg.rope_theta)
         k = apply_rotary_pos_emb(k, position_offset, cfg.rope_theta)
         if cache is not None:
@@ -183,8 +195,16 @@ class LlamaModel(nn.Layer):
         logits = self.lm_head(x)
         return logits if caches is None else (logits, new_caches)
 
-    def init_cache(self, batch_size):
+    def init_cache(self, batch_size, max_length=None):
+        """max_length=None: legacy growing concat cache (recompiles per
+        step — test/back-compat only). max_length=C: fixed-capacity
+        static cache for the compiled decode path."""
         d = self.cfg.hidden_size // self.cfg.num_heads
+        if max_length is not None:
+            from paddle_tpu.inference.decode import init_static_cache
+            return [init_static_cache(batch_size, max_length,
+                                      self.cfg.num_kv_heads, d)
+                    for _ in range(self.cfg.num_layers)]
         z = paddle.zeros([batch_size, 0, self.cfg.num_kv_heads, d])
         return [(z, z) for _ in range(self.cfg.num_layers)]
 
@@ -203,22 +223,24 @@ class LlamaForCausalLM(nn.Layer):
             logits[:, :-1].reshape([-1, logits.shape[-1]]),
             labels[:, 1:].reshape([-1]))
 
+    def init_cache(self, batch_size, max_length=None):
+        return self.llama.init_cache(batch_size, max_length)
+
+    def forward_with_cache(self, input_ids, caches):
+        """DecodeSession contract: (ids, caches) -> (logits, caches)."""
+        return self.llama(input_ids, 0, caches)
+
     @paddle.no_grad()
-    def generate(self, input_ids, max_new_tokens=16, temperature=0.0):
+    def generate(self, input_ids, max_new_tokens=16, temperature=0.0,
+                 top_p=None, seed=0, max_length=None):
+        """Compiled static-shape generation (decode = ONE executable
+        reused every token; the cache is a donated fixed-capacity buffer
+        updated with dynamic_update_slice). Replaces the round-2
+        per-token-recompiling concat path."""
+        from paddle_tpu.inference.decode import cached_generate
         self.eval()
-        caches = self.llama.init_cache(input_ids.shape[0])
-        logits, caches = self.llama(input_ids, 0, caches)
-        out = [input_ids]
-        cur = input_ids
-        pos = input_ids.shape[1]
-        for _ in range(max_new_tokens):
-            last = logits[:, -1]
-            if temperature > 0:
-                nxt = paddle.multinomial(
-                    F.softmax(last / temperature, axis=-1), 1)
-            else:
-                nxt = paddle.argmax(last, axis=-1, keepdim=True)
-            out.append(nxt)
-            logits, caches = self.llama(nxt, pos, caches)
-            pos += 1
-        return paddle.concat(out, axis=1)
+        return cached_generate(self, input_ids, max_new_tokens,
+                               temperature=temperature, top_p=top_p,
+                               seed=seed, max_length=max_length,
+                               seq_ceiling=self.llama.cfg.max_seq_len,
+                               hard_limit=False)

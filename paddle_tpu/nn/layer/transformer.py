@@ -47,13 +47,22 @@ class MultiHeadAttention(Layer):
         b, s, _ = x.shape
         return x.reshape([b, s, self.num_heads, self.head_dim])
 
-    def gen_cache(self, key, value=None, type=None):
+    def gen_cache(self, key, value=None, type=None, max_length=None):
+        """max_length=None keeps the reference's growing concat Cache
+        (every decode step is a new shape — recompiles under jit).
+        max_length=C returns a fixed-capacity decode cache (the
+        TPU-native serving path: dynamic_update_slice writes + length
+        mask, ONE compiled step for all tokens)."""
         if type == MultiHeadAttention.StaticCache:
             k = self._split_heads(self.k_proj(key))
             v = self._split_heads(self.v_proj(value if value is not None
                                               else key))
             return self.StaticCache(k, v)
         b = key.shape[0]
+        if max_length is not None:
+            from paddle_tpu.inference.decode import init_static_cache
+            return init_static_cache(b, max_length, self.num_heads,
+                                     self.head_dim, dtype=key.dtype)
         from paddle_tpu.ops.creation import zeros
         k = zeros([b, 0, self.num_heads, self.head_dim], dtype=key.dtype)
         return self.Cache(k, k)
@@ -63,6 +72,18 @@ class MultiHeadAttention(Layer):
         key = query if key is None else key
         value = key if value is None else value
         q = self._split_heads(self.q_proj(query))
+        from paddle_tpu.inference.decode import (StaticCache as
+                                                 _DecodeCache,
+                                                 cache_attention)
+        if isinstance(cache, _DecodeCache):
+            # fixed-capacity decode path (causality enforced by the
+            # cache length mask; attn_mask is not consulted here)
+            k = self._split_heads(self.k_proj(key))
+            v = self._split_heads(self.v_proj(value))
+            out, new_cache = cache_attention(q, k, v, cache)
+            b, s = out.shape[0], out.shape[1]
+            out = self.out_proj(out.reshape([b, s, self.embed_dim]))
+            return out, new_cache
         if isinstance(cache, self.StaticCache):
             k, v = cache.k, cache.v
             new_cache = cache

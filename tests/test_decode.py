@@ -1,0 +1,243 @@
+"""TPU-native decode/serving path tests (VERDICT r2 item 1).
+
+Covers: static-cache generation numerics vs the legacy concat path,
+zero-recompile guarantees (executable-cache stability), the
+MultiHeadAttention fixed cache, the real masked_multihead_attention, and
+int8-native serving export/load.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _legacy_greedy(m, ids, n):
+    """Round-2 concat-cache greedy loop (the numerics oracle)."""
+    with paddle.no_grad():
+        caches = m.llama.init_cache(ids.shape[0])
+        logits, caches = m.llama(ids, 0, caches)
+        out = [ids]
+        pos = ids.shape[1]
+        for _ in range(n):
+            nxt = paddle.argmax(logits[:, -1], axis=-1, keepdim=True)
+            out.append(nxt)
+            logits, caches = m.llama(nxt, pos, caches)
+            pos += 1
+        return paddle.concat(out, axis=1).numpy()
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    paddle.seed(0)
+    m = LlamaForCausalLM(LlamaConfig.tiny())
+    m.eval()
+    return m
+
+
+def test_static_cache_generation_matches_concat_path(tiny_llama):
+    m = tiny_llama
+    paddle.seed(1)
+    ids = paddle.randint(0, 256, [2, 8])
+    ref = _legacy_greedy(m, ids, 6)
+    new = m.generate(ids, max_new_tokens=6, temperature=0.0).numpy()
+    np.testing.assert_array_equal(ref, new)
+
+
+def test_decode_zero_recompiles_after_warmup(tiny_llama):
+    m = tiny_llama
+    paddle.seed(2)
+    ids = paddle.randint(0, 256, [2, 8])
+    m.generate(ids, max_new_tokens=4, temperature=0.0)
+    sess = next(iter(m._decode_sessions.values()))
+    pre0, dec0 = sess.executable_counts()
+    assert dec0 == 1
+    # more tokens, different prompt content, repeated calls: the decode
+    # executable count must not move
+    m.generate(ids, max_new_tokens=12, temperature=0.0)
+    paddle.seed(3)
+    ids2 = paddle.randint(0, 256, [2, 8])
+    m.generate(ids2, max_new_tokens=9, temperature=0.0)
+    pre1, dec1 = sess.executable_counts()
+    assert dec1 == 1
+    assert pre1 == pre0 == 1
+
+
+def test_prefill_bucketing_bounds_executables(tiny_llama):
+    m = tiny_llama
+    paddle.seed(4)
+    # prompt lengths 5 and 7 share the 16-bucket -> one prefill program
+    ids5 = paddle.randint(0, 256, [1, 5])
+    ids7 = paddle.randint(0, 256, [1, 7])
+    m.generate(ids5, max_new_tokens=3, temperature=0.0)
+    sess = next(iter(m._decode_sessions.values()))
+    n0 = sess.executable_counts()[0]
+    m.generate(ids7, max_new_tokens=3, temperature=0.0)
+    assert sess.executable_counts()[0] == n0
+
+
+def test_generate_sampling_temperature_runs(tiny_llama):
+    m = tiny_llama
+    paddle.seed(5)
+    ids = paddle.randint(0, 256, [2, 6])
+    out = m.generate(ids, max_new_tokens=5, temperature=0.8, top_p=0.9,
+                     seed=7)
+    assert out.shape == [2, 11]
+    # same seed reproduces; different seed (usually) differs
+    out2 = m.generate(ids, max_new_tokens=5, temperature=0.8, top_p=0.9,
+                      seed=7)
+    np.testing.assert_array_equal(out.numpy(), out2.numpy())
+
+
+def test_gpt_static_cache_matches_full_forward():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(0)
+    m = GPTForCausalLM(GPTConfig.tiny())
+    m.eval()
+    ids = paddle.randint(0, 256, [2, 12])
+    with paddle.no_grad():
+        full = m(ids)
+        caches = m.init_cache(2, max_length=32)
+        logits, caches = m.forward_with_cache(ids, caches)
+    np.testing.assert_allclose(full.numpy(), logits.numpy(),
+                               rtol=2e-4, atol=2e-4)
+    # incremental: feed one more token, compare against full forward
+    paddle.seed(1)
+    nxt = paddle.randint(0, 256, [2, 1])
+    with paddle.no_grad():
+        step, caches = m.forward_with_cache(nxt, caches)
+        full2 = m(paddle.concat([ids, nxt], axis=1))
+    np.testing.assert_allclose(full2[:, -1:].numpy(), step.numpy(),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_gpt_generate_runs():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(0)
+    m = GPTForCausalLM(GPTConfig.tiny())
+    ids = paddle.randint(0, 256, [1, 8])
+    out = m.generate(ids, max_new_tokens=4)
+    assert out.shape == [1, 12]
+
+
+def test_llama_static_cache_incremental_matches_full(tiny_llama):
+    m = tiny_llama
+    paddle.seed(6)
+    ids = paddle.randint(0, 256, [2, 12])
+    with paddle.no_grad():
+        full = m.llama(ids)
+        caches = m.init_cache(2, max_length=32)
+        logits, caches = m.forward_with_cache(ids, caches)
+        np.testing.assert_allclose(full.numpy(), logits.numpy(),
+                                   rtol=2e-4, atol=2e-4)
+        # per-layer cache lengths advanced to 12
+        assert int(caches[0].length.numpy()[0]) == 12
+
+
+def test_multihead_attention_decode_cache_matches_concat():
+    paddle.seed(0)
+    mha = nn.MultiHeadAttention(32, 4)
+    mha.eval()
+    x = paddle.randn([2, 6, 32])
+    with paddle.no_grad():
+        # concat-cache path (reference semantics)
+        ccache = mha.gen_cache(x)
+        outs_concat = []
+        for i in range(6):
+            o, ccache = mha(x[:, i:i + 1], x[:, i:i + 1], x[:, i:i + 1],
+                            None, ccache)
+            outs_concat.append(o.numpy())
+        # fixed-capacity decode path
+        dcache = mha.gen_cache(x, max_length=16)
+        outs_static = []
+        for i in range(6):
+            o, dcache = mha(x[:, i:i + 1], x[:, i:i + 1], x[:, i:i + 1],
+                            None, dcache)
+            outs_static.append(o.numpy())
+    for a, b in zip(outs_concat, outs_static):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4)
+    assert int(dcache.length.numpy()[0]) == 6
+    assert dcache.k.shape[1] == 16     # capacity never grew
+
+
+def test_masked_multihead_attention_real():
+    """The incubate decode kernel against a numpy oracle."""
+    from paddle_tpu.incubate.nn import functional as IF
+    rng = np.random.default_rng(0)
+    B, H, C, D = 2, 4, 16, 8
+    lens = np.array([5, 9], np.int32)
+    cache = rng.standard_normal((2, B, H, C, D)).astype(np.float32)
+    x = rng.standard_normal((B, 3 * H * D)).astype(np.float32)
+    out, new_cache = IF.masked_multihead_attention(
+        paddle.to_tensor(x), cache_kv=paddle.to_tensor(cache),
+        sequence_lengths=paddle.to_tensor(lens))
+    qkv = x.reshape(B, 3, H, D)
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+    ref = np.empty((B, H, D), np.float32)
+    nc = cache.copy()
+    for b in range(B):
+        L = lens[b]
+        nc[0, b, :, L] = k[b]
+        nc[1, b, :, L] = v[b]
+        for h in range(H):
+            ks = nc[0, b, h, :L + 1]                    # [L+1, D]
+            vs = nc[1, b, h, :L + 1]
+            logits = ks @ q[b, h] / np.sqrt(D)
+            p = np.exp(logits - logits.max())
+            p /= p.sum()
+            ref[b, h] = p @ vs
+    np.testing.assert_allclose(out.numpy().reshape(B, H, D), ref,
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(new_cache.numpy(), nc, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_int8_native_serving_export_roundtrip(tmp_path):
+    """PTQ -> export int8 payload -> load into fresh model: weights live
+    as int8 in memory, logits match the QDQ-emulated predictor."""
+    from paddle_tpu import inference
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    import jax.numpy as jnp
+    paddle.seed(0)
+    m = LlamaForCausalLM(LlamaConfig.tiny())
+    m.eval()
+    cfg = inference.Config()
+    cfg.set_layer(m)
+    cfg.enable_int8_weight_only()
+    pred = inference.create_predictor(cfg)
+    ids = paddle.randint(0, 256, [2, 8])
+    with paddle.no_grad():
+        qdq_logits = m(ids).numpy()     # QDQ-emulated numerics
+
+    path = str(tmp_path / "llama_int8.npz")
+    inference.save_int8_model(pred, path)
+
+    paddle.seed(0)
+    fresh = LlamaForCausalLM(LlamaConfig.tiny())
+    fresh.eval()
+    n = inference.load_int8_model(fresh, path)
+    swapped = [s for _, s in n.named_sublayers()
+               if isinstance(s, inference.Int8Linear)]
+    assert len(swapped) > 0
+    # int8 actually lives in memory (the HBM payoff)
+    assert swapped[0].weight_q._data.dtype == jnp.int8
+    with paddle.no_grad():
+        int8_logits = fresh(ids).numpy()
+    np.testing.assert_allclose(qdq_logits, int8_logits, rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_predictor_generate_serving(tiny_llama):
+    from paddle_tpu import inference
+    cfg = inference.Config()
+    cfg.set_layer(tiny_llama)
+    cfg.enable_decode(max_length=32)
+    pred = inference.create_predictor(cfg)
+    paddle.seed(8)
+    ids = paddle.randint(0, 256, [2, 8])
+    out = pred.generate(ids, max_new_tokens=5)
+    assert out.shape == [2, 13]
+    ref = _legacy_greedy(tiny_llama, ids, 5)
+    np.testing.assert_array_equal(ref, out.numpy())
+    assert pred.stats["runs"] == 1
