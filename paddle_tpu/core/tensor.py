@@ -45,7 +45,7 @@ class Tensor:
 
     def __init__(self, data=None, dtype=None, place=None, stop_gradient=True,
                  name=None):
-        dt = dtype_mod.convert_dtype(dtype)
+        dt = dtype_mod.jax_dtype(dtype)
         if isinstance(data, Tensor):
             arr = data._data
             if dt is not None and arr.dtype != dt:
@@ -188,7 +188,7 @@ class Tensor:
                 dt = a
         arr = self._data
         if dt is not None:
-            arr = arr.astype(dtype_mod.convert_dtype(dt))
+            arr = arr.astype(dtype_mod.jax_dtype(dt))
         if device is not None:
             arr = jax.device_put(arr, _parse_place(device).get_device())
         out = Tensor._wrap(arr, self.stop_gradient)

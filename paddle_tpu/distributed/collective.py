@@ -97,6 +97,61 @@ class ops:
 # ---------------------------------------------------------------------------
 # eager API-parity collectives on (possibly sharded) tensors
 # ---------------------------------------------------------------------------
+# ---------------------------------------------------------------------------
+# collective deferral (DataParallel.no_sync / gradient accumulation)
+# ---------------------------------------------------------------------------
+# While a deferral context is open, framework-fired gradient-sync
+# collectives (all_reduce/reduce/reduce_scatter and hook-fired grad
+# re-lays) are RECORDED instead of executed, deduped by key, and replayed
+# once on context exit against the then-current (accumulated) tensors —
+# the reference no_sync contract (parallel.py DataParallel.no_sync):
+# skip grad comm until the last microbatch.
+_defer_stack: list = []
+
+
+class _DeferredCalls:
+    def __init__(self):
+        self.calls = {}            # key -> fn (last registration wins)
+        self.skipped = 0
+
+    def add(self, key, fn):
+        if key in self.calls:
+            self.skipped += 1
+        self.calls[key] = fn
+
+    def flush(self):
+        for fn in self.calls.values():
+            fn()
+        self.calls.clear()
+
+
+def deferral_active():
+    return bool(_defer_stack)
+
+
+def defer_or_run(key, fn):
+    """Run fn now, unless a deferral context is open — then record it
+    (deduped by key; replayed once at context exit)."""
+    if _defer_stack:
+        _defer_stack[-1].add(key, fn)
+        return None
+    return fn()
+
+
+class defer_collectives:
+    """Context manager deferring grad-sync collectives until exit."""
+
+    def __enter__(self):
+        _defer_stack.append(_DeferredCalls())
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        rec = _defer_stack.pop()
+        if exc_type is None:
+            rec.flush()
+        return False
+
+
 def _world(group):
     return group.nranks if group is not None else get_world_size()
 
@@ -114,6 +169,11 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
     collective.ops.psum/pmax/... inside shard_map — use that in parallel
     regions. A sharded eager input is gathered to replicated (its global
     value is unchanged; no reduction is performed)."""
+    if deferral_active():
+        _defer_stack[-1].add(("all_reduce", id(tensor), id(group)),
+                             lambda: all_reduce(tensor, op, group,
+                                                sync_op))
+        return _Task(tensor)
     sharding = getattr(tensor._data, "sharding", None)
     if sharding is not None and not sharding.is_fully_replicated:
         tensor._data = jax.device_put(
@@ -159,6 +219,11 @@ def scatter(tensor: Tensor, tensor_list=None, src=0, group=None,
 
 def reduce_scatter(tensor: Tensor, tensor_list, op=ReduceOp.SUM, group=None,
                    sync_op=True):
+    if deferral_active():
+        _defer_stack[-1].add(("reduce_scatter", id(tensor), id(group)),
+                             lambda: reduce_scatter(tensor, tensor_list,
+                                                    op, group, sync_op))
+        return _Task(tensor)
     if tensor_list:
         acc = tensor_list[0]._data
         tensor._assign_array(acc)

@@ -217,8 +217,19 @@ class _Fleet:
         return model
 
     def distributed_optimizer(self, optimizer, strategy=None):
-        return HybridParallelOptimizer(optimizer, self._hcg,
-                                       strategy or self._strategy)
+        strategy = strategy or self._strategy
+        opt = HybridParallelOptimizer(optimizer, self._hcg, strategy)
+        if strategy is not None and getattr(strategy, "gradient_merge",
+                                            False):
+            # reference auto_parallel_gradient_merge pass: k-step
+            # accumulation OUTSIDE the (possibly sharded) update
+            from paddle_tpu.optimizer.gradient_merge import \
+                GradientMergeOptimizer
+            cfgs = getattr(strategy, "gradient_merge_configs", {}) or {}
+            return GradientMergeOptimizer(
+                opt, k_steps=int(cfgs.get("k_steps", 1)),
+                avg=bool(cfgs.get("avg", True)))
+        return opt
 
     # --------------------------------------------- parameter-server mode
     # (reference fleet.py init_server/run_server/init_worker/stop_worker)

@@ -70,8 +70,22 @@ class DataParallel(Layer):
         return loss  # mean-reduction over the global batch is already global
 
     def no_sync(self):
-        import contextlib
-        return contextlib.nullcontext()
+        """Defer gradient synchronization until the context exits
+        (reference DataParallel.no_sync, parallel.py:219 area).
+
+        Real effect: every framework-fired grad-sync collective
+        (fused_allreduce_gradients, sharding stage-2 grad re-lays,
+        user C.all_reduce on grads) inside the context is recorded,
+        deduped, and fired ONCE on exit against the accumulated grads.
+        Note the GSPMD caveat: reductions XLA embeds inside a compiled
+        backward (replicated-param grads over a dp-sharded batch) are
+        compiler-owned and not deferrable here — for fully deferred
+        compiled accumulation use gradient_merge
+        (optimizer.GradientMergeOptimizer / ParallelConfig.
+        gradient_merge_steps), where the whole k-step loop is one XLA
+        program and the reduction happens once by construction."""
+        from . import collective as C
+        return C.defer_collectives()
 
     def state_dict(self, *a, **k):
         return self._layers.state_dict(*a, **k)

@@ -141,15 +141,22 @@ class GroupShardedStage2(Layer):
             p._register_backward_hook(self._reshard_grad)
 
     def _reshard_grad(self, leaf: Tensor):
-        g = leaf.grad
-        if g is None:
-            return
-        spec = _shard_spec(g._data.shape, self._mesh, self._axis)
-        if spec is None:
-            return
-        sh = NamedSharding(self._mesh.jax_mesh, spec)
-        if getattr(g._data, "sharding", None) != sh:
-            g._data = jax.device_put(g._data, sh)
+        from . import collective as C
+
+        def relay():
+            g = leaf.grad
+            if g is None:
+                return
+            spec = _shard_spec(g._data.shape, self._mesh, self._axis)
+            if spec is None:
+                return
+            sh = NamedSharding(self._mesh.jax_mesh, spec)
+            if getattr(g._data, "sharding", None) != sh:
+                g._data = jax.device_put(g._data, sh)
+        # under no_sync the re-lay (the stage-2 reduce-scatter analog)
+        # is deferred to the context exit — one re-lay per param per
+        # accumulation window instead of one per microbatch
+        C.defer_or_run(("stage2_relay", id(leaf)), relay)
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)

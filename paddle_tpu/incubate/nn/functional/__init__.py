@@ -211,6 +211,15 @@ def masked_multihead_attention(x, cache_kv=None, src_mask=None,
             "src_mask is not supported on the TPU decode path: causality "
             "comes from the cache length mask (mask lengths via "
             "sequence_lengths instead)")
+    if out_scale is not None and out_scale > 0:
+        raise NotImplementedError(
+            "quantized (int8) attention output (out_scale>0) is not "
+            "implemented — serve with inference int8 weight-only "
+            "quantization instead")
+    if compute_dtype not in ("default", "fp32", "float32"):
+        raise NotImplementedError(
+            f"compute_dtype={compute_dtype!r}: only fp32 compute is "
+            "implemented (cast x/cache_kv for bf16 storage)")
     num_heads = cache_kv.shape[2]
     theta = None
     if rotary_emb_dims and rotary_emb_dims > 0:

@@ -83,3 +83,7 @@ class ModelAverage:
         for p in self._params:
             p._assign_array(self._backup[id(p)])
         self._backup = None
+
+from paddle_tpu.optimizer.gradient_merge import (  # noqa: F401
+    GradientMergeOptimizer,
+)

@@ -219,7 +219,7 @@ class Predictor:
         (args, None, None) means no padding happened (None, not 0 —
         a true batch of 0 pads and must still trim)."""
         buckets = self._config._buckets
-        if not buckets or not args:
+        if not buckets or not args or args[0]._data.ndim == 0:
             return args, None, None
         batch = args[0].shape[0]
         tgt = next((k for k in buckets if k >= batch), buckets[-1])
@@ -341,9 +341,12 @@ class Predictor:
         flags = self._batch_output_flags(args) if buckets and args \
             else None
         # any batch-dependent-but-not-batch output (dim0 = 2B etc.)
-        # cannot be padded-and-trimmed NOR chunked: run unbucketed
-        bucketable = not (flags is not None
-                          and any(f == "affine" for f in flags))
+        # cannot be padded-and-trimmed NOR chunked: run unbucketed.
+        # A failed probe (flags None) also skips bucketing: without
+        # per-output knowledge, trimming would have to guess which
+        # outputs track the batch.
+        bucketable = (not buckets or not args) if flags is None else \
+            not any(f == "affine" for f in flags)
         if buckets and args and bucketable \
                 and args[0].shape[0] > buckets[-1]:
             # bigger than the top bucket: chunk into top-bucket pieces
@@ -610,7 +613,7 @@ def load_int8_model(layer, path: str, compute_dtype="float32"):
             shape = [1] * q.ndim
             shape[ax % q.ndim] = -1
             deq = q.astype(np.float32) * sc.reshape(shape)
-            p._assign_array(jnp.asarray(deq, np.asarray(p._data).dtype))
+            p._assign_array(jnp.asarray(deq, p._data.dtype))
             p._int8_payload = (q, sc)
         elif name in data.files:
             p._assign_array(jnp.asarray(data[name]))

@@ -90,10 +90,25 @@ def _cache_attention(q, kn, vn, kbuf, vbuf, lens):
             lens + jnp.int32(s))
 
 
+def _check_capacity(length, s_new, capacity):
+    """Eager misuse guard: writing past capacity would silently clamp
+    (dynamic_update_slice semantics) and corrupt the newest cache slot.
+    Lengths are concrete in eager mode — check them; under a trace the
+    DecodeSession has already sized the cache."""
+    arr = length._data if isinstance(length, Tensor) else length
+    if not isinstance(arr, jax.core.Tracer):
+        top = int(jax.device_get(jnp.max(arr))) + s_new
+        if top > capacity:
+            raise ValueError(
+                f"KV cache overflow: writing {s_new} token(s) at length "
+                f"{top - s_new} exceeds capacity {capacity}")
+
+
 def cache_attention(q, k_new, v_new, cache: StaticCache):
     """Eager-op wrapper: attend q against (cache ++ new kv), updating the
     cache in place. Returns (out, new_cache). Not differentiable (serving
     path)."""
+    _check_capacity(cache.length, q.shape[1], cache.k.shape[1])
     out, k2, v2, l2 = run_op(
         "masked_cache_attention", _cache_attention, q, k_new, v_new,
         cache.k, cache.v, cache.length, n_outputs=4, differentiable=False)
@@ -108,6 +123,9 @@ def masked_multihead_attention_impl(x, cache_kv, seq_lens, num_heads,
     (the reference's cache layout); seq_lens: [B] int32 lengths before this
     step. Returns (out [B, H*D], new cache_kv).
     """
+    _check_capacity(seq_lens, 1, (cache_kv.shape[3] if hasattr(
+        cache_kv, "shape") else cache_kv._data.shape[3]))
+
     def f(xa, ck, lens):
         b = xa.shape[0]
         h = num_heads
@@ -275,8 +293,16 @@ class DecodeSession:
         return nxt, key, cache_out
 
     # -- public API -----------------------------------------------------
-    def generate(self, input_ids, max_new_tokens=16, seed=0):
-        """Generate tokens; returns [B, prompt + n_generated] ids."""
+    def generate(self, input_ids, max_new_tokens=16, seed=None):
+        """Generate tokens; returns [B, prompt + n_generated] ids.
+
+        seed=None (default) draws the sampling key from the framework's
+        global generator — successive calls produce different samples,
+        matching the legacy eager path; pass an int for reproducibility.
+        Sequences that emit eos_token_id are pinned to eos for the rest
+        of the batch (per-sequence finished state); the loop exits early
+        once every sequence has finished (checked every 8 steps so the
+        device pipeline is not serialized by per-token host syncs)."""
         ids = input_ids._data if isinstance(input_ids, Tensor) else \
             jnp.asarray(input_ids)
         ids = ids.astype(jnp.int32)
@@ -296,17 +322,29 @@ class DecodeSession:
             [tuple(c) for c in caches])
         cache_arrays = [x._data for c in caches for x in c]
         state = [t._data for t in self._state]
-        key = jax.random.PRNGKey(seed)
+        if seed is None:
+            from paddle_tpu.core import generator as gen_mod
+            key = gen_mod.default_generator().next_key()
+        else:
+            key = jax.random.PRNGKey(seed)
 
         token, key, cache_arrays = self._prefill_jit(
             *state, padded, lens, key, *cache_arrays)
+        finished = jnp.zeros((b,), bool) if self._eos is not None else None
+        if finished is not None:
+            finished = finished | (token == self._eos)
         outs = [token]
-        for _ in range(max_new_tokens - 1):
+        for i in range(max_new_tokens - 1):
             token, key, cache_arrays = self._decode_jit(
                 *state, token, key, *cache_arrays)
+            if finished is not None:
+                # pin finished sequences to eos; update finished state
+                token = jnp.where(finished, jnp.int32(self._eos), token)
+                finished = finished | (token == self._eos)
             outs.append(token)
-            if self._eos is not None and bool(
-                    jnp.all(token == self._eos)):
+            # early exit probed only every 8 steps: keeps dispatch async
+            if finished is not None and (i % 8 == 7) and bool(
+                    jax.device_get(jnp.all(finished))):
                 break
         gen = jnp.stack(outs, axis=1)
         return Tensor._wrap(jnp.concatenate([ids, gen], axis=1), True)
@@ -319,7 +357,7 @@ class DecodeSession:
 
 
 def cached_generate(model, input_ids, max_new_tokens=16, temperature=0.0,
-                    top_p=None, seed=0, max_length=None, seq_ceiling=None,
+                    top_p=None, seed=None, max_length=None, seq_ceiling=None,
                     hard_limit=False):
     """Shared model.generate() implementation: pick a cache capacity
     (next power of two covering prompt+new, floored at 64), cache one

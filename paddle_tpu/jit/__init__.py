@@ -194,13 +194,24 @@ def _decisions_match(a, b):
     """Compare decision traces; float-valued float()/item() guards get
     a small relative tolerance — the compiled program may differ from
     the eager probe by an ulp (fusion/reduction order), and exact
-    equality would ping-pong probe/compiled forever."""
+    equality would ping-pong probe/compiled forever.
+
+    CAVEAT (documented contract): float guards are therefore
+    APPROXIMATE. A live value landing within 1e-6 of the recorded one
+    but on the other side of a user threshold (``if x.item() > 0.5``
+    with values 0.5 +/- 5e-7) validates the cached specialization and
+    takes the recorded branch. Mixed-sign pairs never match (the most
+    common threshold is 0); user code comparing against knife-edge
+    constants at sub-1e-6 resolution should branch on int/bool guards
+    instead."""
     if len(a) != len(b):
         return False
     for (ka, va), (kb, vb) in zip(a, b):
         if ka != kb:
             return False
         if isinstance(va, float) and isinstance(vb, float):
+            if (va > 0) != (vb > 0):
+                return False       # sign flip: always re-probe
             if va != vb and not (
                     abs(va - vb) <= 1e-6 * max(1.0, abs(va), abs(vb))):
                 return False

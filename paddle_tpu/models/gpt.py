@@ -187,7 +187,7 @@ class GPTForCausalLM(nn.Layer):
 
     @paddle.no_grad()
     def generate(self, input_ids, max_new_tokens=16, temperature=0.0,
-                 top_p=None, seed=0, max_length=None):
+                 top_p=None, seed=None, max_length=None):
         """Compiled static-shape generation over the fixed-capacity KV
         cache (see inference/decode.py)."""
         from paddle_tpu.inference.decode import cached_generate

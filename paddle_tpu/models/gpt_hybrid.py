@@ -60,6 +60,12 @@ class ParallelConfig:
     # these hurts via memory pressure, fewer recomputes the flash
     # kernel in backward)
     remat_save_names: tuple = ("attn_out", "ffn1", "qkv")
+    # k-step gradient merge INSIDE the compiled step: the batch is split
+    # into k chunks, grads accumulate across a lax.scan and the
+    # optimizer applies the averaged grad once — the reference
+    # auto_parallel_gradient_merge pass, with the deferred reduction
+    # falling out of XLA compiling the whole loop as one program
+    gradient_merge_steps: int = 1
     zero1: bool = True        # shard adam moments over dp
     fused_ce: bool = True     # chunked LM-head+CE (ops/fused_ce.py);
                               # never materializes [T, V] logits
@@ -516,18 +522,45 @@ def build_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
             f"pp_schedule must be 'gpipe' or '1f1b', got "
             f"{pcfg.pp_schedule!r}")
     if pcfg.pp > 1 and pcfg.pp_schedule == "1f1b":
+        def grads_of(params, batch):
+            return _train_grads_1f1b(params, batch, cfg, pcfg, mesh)
+    else:
+        def grads_of(params, batch):
+            return jax.value_and_grad(
+                lambda p: loss_fn(p, batch, cfg, pcfg, mesh))(params)
+
+    k = pcfg.gradient_merge_steps
+    if k > 1:
         def train_step(params, opt_state, batch):
-            loss, grads = _train_grads_1f1b(params, batch, cfg, pcfg,
-                                            mesh)
+            # split the global batch into k merge chunks and scan:
+            # the grad accumulator lives in HBM across the loop and the
+            # dp reduction is compiled once (gradient-merge semantics)
+            b0 = jax.tree_util.tree_leaves(batch)[0].shape[0]
+            if b0 % k:
+                raise ValueError(
+                    f"global batch {b0} is not divisible by "
+                    f"gradient_merge_steps={k}")
+            chunks = jax.tree_util.tree_map(
+                lambda x: x.reshape((k, x.shape[0] // k) + x.shape[1:]),
+                batch)
+
+            def body(carry, mb):
+                acc, lsum = carry
+                loss, grads = grads_of(params, mb)
+                acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+                return (acc, lsum + loss), None
+
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+            (acc, lsum), _ = jax.lax.scan(body, (zeros, 0.0), chunks)
+            grads = jax.tree_util.tree_map(lambda g: g / k, acc)
             new_params, new_opt = adamw_update(params, grads, opt_state,
                                                lr=lr)
-            return new_params, new_opt, loss
+            return new_params, new_opt, lsum / k
 
         return jax.jit(train_step, donate_argnums=(0, 1))
 
     def train_step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(p, batch, cfg, pcfg, mesh))(params)
+        loss, grads = grads_of(params, batch)
         new_params, new_opt = adamw_update(params, grads, opt_state, lr=lr)
         return new_params, new_opt, loss
 

@@ -232,7 +232,7 @@ class LlamaForCausalLM(nn.Layer):
 
     @paddle.no_grad()
     def generate(self, input_ids, max_new_tokens=16, temperature=0.0,
-                 top_p=None, seed=0, max_length=None):
+                 top_p=None, seed=None, max_length=None):
         """Compiled static-shape generation (decode = ONE executable
         reused every token; the cache is a donated fixed-capacity buffer
         updated with dynamic_update_slice). Replaces the round-2

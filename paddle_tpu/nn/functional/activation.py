@@ -158,7 +158,7 @@ def maxout(x, groups, axis=1, name=None):
 
 def softmax(x, axis=-1, dtype=None, name=None):
     from paddle_tpu.core import dtype as dtype_mod
-    d = dtype_mod.convert_dtype(dtype)
+    d = dtype_mod.jax_dtype(dtype)
     def f(a):
         if d is not None:
             a = a.astype(d)
@@ -174,7 +174,7 @@ def softmax_(x, axis=-1, dtype=None, name=None):
 
 def log_softmax(x, axis=-1, dtype=None, name=None):
     from paddle_tpu.core import dtype as dtype_mod
-    d = dtype_mod.convert_dtype(dtype)
+    d = dtype_mod.jax_dtype(dtype)
     def f(a):
         if d is not None:
             a = a.astype(d)

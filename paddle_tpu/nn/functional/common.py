@@ -317,7 +317,7 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
     from paddle_tpu.core import dtype as dtype_mod
     if maxlen is None:
         maxlen = int(np.asarray(x._data).max())
-    d = dtype_mod.convert_dtype(dtype)
+    d = dtype_mod.jax_dtype(dtype)
     def f(lengths):
         ids = jnp.arange(maxlen)
         return (ids[None, :] < lengths[..., None]).astype(d)

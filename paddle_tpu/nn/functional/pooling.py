@@ -6,6 +6,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu.core import dtype as dtype_mod
+
 from paddle_tpu.core.dispatch import run_op
 
 
@@ -92,7 +94,7 @@ def _max_pool_with_mask(name, x, n, kernel_size, stride, padding,
             combine, dims, strides, pads)
         if channels_last:
             mask = jnp.moveaxis(mask, 1, -1)
-        return mask.astype(jnp.int64)
+        return mask.astype(dtype_mod.jax_dtype("int64"))
 
     mask = run_op(name + "_mask", f_mask, x, differentiable=False)
     return out, mask

@@ -33,7 +33,7 @@ class Constant(Initializer):
         self.value = value
 
     def __call__(self, shape, dtype):
-        return jnp.full(shape, self.value, dtype_mod.convert_dtype(dtype))
+        return jnp.full(shape, self.value, dtype_mod.jax_dtype(dtype))
 
 
 class Normal(Initializer):
@@ -43,7 +43,7 @@ class Normal(Initializer):
     def __call__(self, shape, dtype):
         key = gen_mod.next_key()
         return (self.mean + self.std * jax.random.normal(
-            key, tuple(shape))).astype(dtype_mod.convert_dtype(dtype))
+            key, tuple(shape))).astype(dtype_mod.jax_dtype(dtype))
 
 
 class TruncatedNormal(Initializer):
@@ -54,7 +54,7 @@ class TruncatedNormal(Initializer):
         key = gen_mod.next_key()
         z = jax.random.truncated_normal(key, self.a, self.b, tuple(shape))
         return (self.mean + self.std * z).astype(
-            dtype_mod.convert_dtype(dtype))
+            dtype_mod.jax_dtype(dtype))
 
 
 class Uniform(Initializer):
@@ -64,7 +64,7 @@ class Uniform(Initializer):
     def __call__(self, shape, dtype):
         key = gen_mod.next_key()
         return jax.random.uniform(
-            key, tuple(shape), dtype_mod.convert_dtype(dtype),
+            key, tuple(shape), dtype_mod.jax_dtype(dtype),
             minval=self.low, maxval=self.high)
 
 
@@ -92,7 +92,7 @@ class XavierNormal(Initializer):
         std = self.gain * math.sqrt(2.0 / (fi + fo))
         key = gen_mod.next_key()
         return (std * jax.random.normal(key, tuple(shape))).astype(
-            dtype_mod.convert_dtype(dtype))
+            dtype_mod.jax_dtype(dtype))
 
 
 class XavierUniform(Initializer):
@@ -106,7 +106,7 @@ class XavierUniform(Initializer):
         limit = self.gain * math.sqrt(6.0 / (fi + fo))
         key = gen_mod.next_key()
         return jax.random.uniform(
-            key, tuple(shape), dtype_mod.convert_dtype(dtype),
+            key, tuple(shape), dtype_mod.jax_dtype(dtype),
             minval=-limit, maxval=limit)
 
 
@@ -123,7 +123,7 @@ class KaimingNormal(Initializer):
         std = gain / math.sqrt(fi)
         key = gen_mod.next_key()
         return (std * jax.random.normal(key, tuple(shape))).astype(
-            dtype_mod.convert_dtype(dtype))
+            dtype_mod.jax_dtype(dtype))
 
 
 class KaimingUniform(Initializer):
@@ -139,7 +139,7 @@ class KaimingUniform(Initializer):
         limit = gain * math.sqrt(3.0 / fi)
         key = gen_mod.next_key()
         return jax.random.uniform(
-            key, tuple(shape), dtype_mod.convert_dtype(dtype),
+            key, tuple(shape), dtype_mod.jax_dtype(dtype),
             minval=-limit, maxval=limit)
 
 
@@ -151,7 +151,7 @@ class Assign(Initializer):
         from paddle_tpu.core.tensor import Tensor
         v = self.value._data if isinstance(self.value, Tensor) \
             else jnp.asarray(np.asarray(self.value))
-        return v.reshape(shape).astype(dtype_mod.convert_dtype(dtype))
+        return v.reshape(shape).astype(dtype_mod.jax_dtype(dtype))
 
 
 class Orthogonal(Initializer):
@@ -161,7 +161,7 @@ class Orthogonal(Initializer):
     def __call__(self, shape, dtype):
         key = gen_mod.next_key()
         return (self.gain * jax.nn.initializers.orthogonal()(
-            key, tuple(shape))).astype(dtype_mod.convert_dtype(dtype))
+            key, tuple(shape))).astype(dtype_mod.jax_dtype(dtype))
 
 
 class Dirac(Initializer):
@@ -177,7 +177,7 @@ class Dirac(Initializer):
             for i in range(min(per, in_c)):
                 idx = (g * per + i, i) + tuple(centers)
                 w[idx] = 1.0
-        return jnp.asarray(w, dtype_mod.convert_dtype(dtype))
+        return jnp.asarray(w, dtype_mod.jax_dtype(dtype))
 
 
 class Bilinear(Initializer):
@@ -196,7 +196,7 @@ class Bilinear(Initializer):
         w = np.zeros(shape, np.float32)
         for i in range(min(shape[0], shape[1])):
             w[i, i] = filt
-        return jnp.asarray(w, dtype_mod.convert_dtype(dtype))
+        return jnp.asarray(w, dtype_mod.jax_dtype(dtype))
 
 
 # default initializer used by layers when weight_attr is None

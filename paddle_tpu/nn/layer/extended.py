@@ -7,6 +7,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu.core import dtype as dtype_mod
+
 from paddle_tpu.core.tensor import Parameter, Tensor
 from .layers import Layer
 from ..functional import extended as FE
@@ -378,7 +380,7 @@ def dynamic_decode(decoder, inits=None, max_step_num=100, output_time_major=Fals
             | (tok == end))
         lengths = Tensor._wrap(
             jnp.take_along_axis(lengths._data, beam_idx, 1)
-            + (~finished._data).astype(jnp.int64))
+            + (~finished._data).astype(dtype_mod.jax_dtype("int64")))
         # reorder states along beam dim
 
         def reorder(s):
@@ -392,7 +394,7 @@ def dynamic_decode(decoder, inits=None, max_step_num=100, output_time_major=Fals
                                             + arr.shape[2:]))
         states = jax.tree_util.tree_map(
             reorder, states, is_leaf=lambda v: isinstance(v, Tensor))
-        cur = Tensor._wrap(tok.astype(jnp.int64))
+        cur = Tensor._wrap(tok.astype(dtype_mod.jax_dtype("int64")))
         step_ids.append(cur)
         if bool(jnp.all(finished._data)):
             break

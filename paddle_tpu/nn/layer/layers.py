@@ -54,7 +54,7 @@ class _HookRemoveHelper:
 class Layer:
     def __init__(self, name_scope=None, dtype="float32"):
         self.training = True
-        self._dtype = dtype_mod.convert_dtype(dtype)
+        self._dtype = dtype_mod.jax_dtype(dtype)
         self._parameters: Dict[str, Parameter] = collections.OrderedDict()
         self._buffers: Dict[str, Tensor] = collections.OrderedDict()
         self._non_persistable_buffer_names = set()
@@ -131,7 +131,7 @@ class Layer:
         attr = ParamAttr._to_attr(attr)
         if attr is None:
             return None
-        dt = dtype_mod.convert_dtype(dtype) or self._dtype
+        dt = dtype_mod.jax_dtype(dtype) or self._dtype
         init = attr.initializer or default_initializer or (
             init_mod.Constant(0.0) if is_bias else init_mod._GLOBAL_DEFAULT)
         data = init(tuple(int(s) for s in shape), dt)
@@ -144,7 +144,7 @@ class Layer:
 
     def create_tensor(self, name=None, persistable=None, dtype=None):
         return Tensor._wrap(
-            jnp.zeros((), dtype_mod.convert_dtype(dtype) or self._dtype))
+            jnp.zeros((), dtype_mod.jax_dtype(dtype) or self._dtype))
 
     # --------------------------------------------------------- iteration
     def parameters(self, include_sublayers=True) -> List[Parameter]:
@@ -301,16 +301,16 @@ class Layer:
         from paddle_tpu.core.place import _parse_place
         def fn(a):
             if dtype is not None and jnp.issubdtype(a.dtype, jnp.floating):
-                a = a.astype(dtype_mod.convert_dtype(dtype))
+                a = a.astype(dtype_mod.jax_dtype(dtype))
             if device is not None:
                 a = jax.device_put(a, _parse_place(device).get_device())
             return a
         if dtype is not None:
-            self._dtype = dtype_mod.convert_dtype(dtype)
+            self._dtype = dtype_mod.jax_dtype(dtype)
         return self._transform(fn)
 
     def astype(self, dtype):
-        d = dtype_mod.convert_dtype(dtype)
+        d = dtype_mod.jax_dtype(dtype)
         self._dtype = d
         return self._transform(
             lambda a: a.astype(d) if jnp.issubdtype(a.dtype, jnp.floating)

@@ -428,7 +428,7 @@ def create_parameter(shape, dtype, name=None, attr=None,
         init = getattr(attr, "initializer", None)
     if init is None:
         init = I.Constant(0.0) if is_bias else I.XavierNormal()
-    dt = dtype_mod.convert_dtype(dtype) or np.float32
+    dt = dtype_mod.jax_dtype(dtype) or np.float32
     shape = [int(s) for s in shape]
     p = Parameter(init(shape, dt))
     p.stop_gradient = False

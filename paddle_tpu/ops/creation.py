@@ -15,7 +15,8 @@ def _dt(dtype, default_float=True):
     d = dtype_mod.convert_dtype(dtype)
     if d is None and default_float:
         d = dtype_mod.get_default_dtype()
-    return d
+    # explicit x64 downgrade (no jax truncation warning; honest under x64)
+    return dtype_mod.jax_dtype(d) if d is not None else None
 
 
 def to_tensor(data, dtype=None, place=None, stop_gradient=True):
@@ -81,7 +82,8 @@ def arange(start=0, end=None, step=1, dtype=None, name=None):
         d = np.dtype(np.int64) if all(
             isinstance(v, (int, np.integer)) for v in (start, end, step)) \
             else dtype_mod.get_default_dtype()
-    return Tensor._wrap(jnp.arange(start, end, step, dtype=d))
+    return Tensor._wrap(jnp.arange(start, end, step,
+                                   dtype=dtype_mod.jax_dtype(d)))
 
 
 def linspace(start, stop, num, dtype=None, name=None):
@@ -168,14 +170,14 @@ def tril_indices(row, col=None, offset=0, dtype="int64"):
     col = col if col is not None else row
     r, c = np.tril_indices(row, offset, col)
     return Tensor._wrap(jnp.asarray(np.stack([r, c]),
-                                    dtype=dtype_mod.convert_dtype(dtype)))
+                                    dtype=dtype_mod.jax_dtype(dtype)))
 
 
 def triu_indices(row, col=None, offset=0, dtype="int64"):
     col = col if col is not None else row
     r, c = np.triu_indices(row, offset, col)
     return Tensor._wrap(jnp.asarray(np.stack([r, c]),
-                                    dtype=dtype_mod.convert_dtype(dtype)))
+                                    dtype=dtype_mod.jax_dtype(dtype)))
 
 
 def assign(x, output=None):
@@ -213,4 +215,4 @@ def create_tensor(dtype, name=None, persistable=False):
     placeholder (legacy static helper)."""
     from paddle_tpu.core import dtype as dtype_mod
     from paddle_tpu.core.tensor import Tensor
-    return Tensor(np.zeros((), dtype_mod.convert_dtype(dtype)))
+    return Tensor(np.zeros((), dtype_mod.jax_dtype(dtype)))

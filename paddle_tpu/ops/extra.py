@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from paddle_tpu.core import dtype as dtype_mod
 from paddle_tpu.core import generator as gen_mod
 from paddle_tpu.core.dispatch import run_op
 from paddle_tpu.core.tensor import Tensor
@@ -213,7 +214,7 @@ def tensor_unfold(x, axis, size, step):
 
 def view_dtype(x, dtype):
     from paddle_tpu.core import dtype as dtype_mod
-    jd = dtype_mod.convert_dtype(dtype)
+    jd = dtype_mod.jax_dtype(dtype)
     return run_op("view_dtype",
                   lambda a: lax.bitcast_convert_type(a, jd), _t(x))
 
@@ -445,7 +446,7 @@ def viterbi_decode(potentials, transition_params, lengths,
         _, path_rev = lax.scan(back, last, (backptrs[::-1], idxs))
         path = jnp.concatenate(
             [path_rev[::-1].T, last[:, None]], 1)
-        return score, path.astype(jnp.int64)
+        return score, path.astype(dtype_mod.jax_dtype("int64"))
     lengths_arr = _t(lengths)._data
     return run_op("viterbi_decode", f, _t(potentials),
                   _t(transition_params))

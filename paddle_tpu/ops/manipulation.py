@@ -25,7 +25,7 @@ def _ints(seq):
 
 
 def cast(x, dtype):
-    d = dtype_mod.convert_dtype(dtype)
+    d = dtype_mod.jax_dtype(dtype)
     if x.dtype == d:
         return x
     if dtype_mod.is_floating_point(x.dtype) and (
@@ -35,7 +35,7 @@ def cast(x, dtype):
 
 
 def cast_(x, dtype):
-    d = dtype_mod.convert_dtype(dtype)
+    d = dtype_mod.jax_dtype(dtype)
     x._assign_array(x._data.astype(d))
     return x
 
@@ -446,7 +446,7 @@ def where_(condition, x, y, name=None):
 
 
 def numel(x, name=None):
-    return Tensor._wrap(jnp.asarray(x.size, jnp.int64))
+    return Tensor._wrap(jnp.asarray(x.size, dtype_mod.jax_dtype("int64")))
 
 
 def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):

@@ -264,19 +264,19 @@ def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
 
 
 def cumsum(x, axis=None, dtype=None, name=None):
-    d = dtype_mod.convert_dtype(dtype)
+    d = dtype_mod.jax_dtype(dtype)
     return run_op("cumsum", lambda a: jnp.cumsum(a, axis=axis, dtype=d), x)
 
 
 def cumprod(x, dim=None, dtype=None, name=None):
-    d = dtype_mod.convert_dtype(dtype)
+    d = dtype_mod.jax_dtype(dtype)
     return run_op("cumprod", lambda a: jnp.cumprod(a, axis=dim, dtype=d), x)
 
 
 def _index_dtype(dtype):
     """int64 only when jax x64 is actually enabled; canonical int32
     otherwise (avoids jax's warn-and-truncate on int64 requests)."""
-    d = dtype_mod.convert_dtype(dtype if dtype is not None else "int64")
+    d = dtype_mod.jax_dtype(dtype if dtype is not None else "int64")
     if d == np.int64 and not jax.config.jax_enable_x64:
         return jnp.int32
     return d
@@ -346,11 +346,11 @@ def _axis_arg(axis):
 
 def sum(x, axis=None, dtype=None, keepdim=False, name=None):
     ax = _axis_arg(axis)
-    d = dtype_mod.convert_dtype(dtype)
+    d = dtype_mod.jax_dtype(dtype)
     def f(a):
         out_dtype = d
         if out_dtype is None and jnp.issubdtype(a.dtype, jnp.integer):
-            out_dtype = jnp.int64
+            out_dtype = dtype_mod.jax_dtype("int64")
         return jnp.sum(a, axis=ax, dtype=out_dtype, keepdims=keepdim)
     return run_op("sum", f, x)
 
@@ -362,7 +362,7 @@ def mean(x, axis=None, keepdim=False, name=None):
 
 def prod(x, axis=None, keepdim=False, dtype=None, name=None):
     ax = _axis_arg(axis)
-    d = dtype_mod.convert_dtype(dtype)
+    d = dtype_mod.jax_dtype(dtype)
     return run_op("prod",
                   lambda a: jnp.prod(a, axis=ax, dtype=d, keepdims=keepdim), x)
 
@@ -401,7 +401,7 @@ def var(x, axis=None, unbiased=True, keepdim=False, name=None):
 
 def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
     ax = _axis_arg(axis)
-    d = dtype_mod.convert_dtype(dtype)
+    d = dtype_mod.jax_dtype(dtype)
     return run_op("nansum",
                   lambda a: jnp.nansum(a, axis=ax, dtype=d, keepdims=keepdim),
                   x)

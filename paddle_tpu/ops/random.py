@@ -17,8 +17,10 @@ from .creation import _shape_tuple
 
 
 def _dt(dtype):
-    d = dtype_mod.convert_dtype(dtype)
-    return d if d is not None else dtype_mod.get_default_dtype()
+    d = dtype_mod.jax_dtype(dtype)
+    d = d if d is not None else dtype_mod.get_default_dtype()
+    # explicit x64 downgrade (no jax truncation warning; honest under x64)
+    return dtype_mod.jax_dtype(d)
 
 
 def rand(shape, dtype=None, name=None):
@@ -62,23 +64,23 @@ def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
     key = gen_mod.next_key()
     return Tensor._wrap(jax.random.randint(
         key, _shape_tuple(shape), low, high,
-        dtype=dtype_mod.convert_dtype(dtype)))
+        dtype=dtype_mod.jax_dtype(dtype)))
 
 
 def randint_like(x, low=0, high=None, dtype=None, name=None):
-    d = dtype_mod.convert_dtype(dtype) or x.dtype
+    d = dtype_mod.jax_dtype(dtype) or x.dtype
     if high is None:
         low, high = 0, low
     key = gen_mod.next_key()
     out = jax.random.randint(key, tuple(x.shape), int(low), int(high),
-                             dtype=jnp.int64)
-    return Tensor._wrap(out.astype(d))
+                             dtype=dtype_mod.jax_dtype("int64"))
+    return Tensor._wrap(out.astype(dtype_mod.jax_dtype(d)))
 
 
 def randperm(n, dtype="int64", name=None):
     key = gen_mod.next_key()
     return Tensor._wrap(jax.random.permutation(key, n).astype(
-        dtype_mod.convert_dtype(dtype)))
+        dtype_mod.jax_dtype(dtype)))
 
 
 def bernoulli(x, name=None):
@@ -98,7 +100,8 @@ def bernoulli_(x, p=0.5, name=None):
 def binomial(count, prob, name=None):
     key = gen_mod.next_key()
     def f(n, p):
-        return jax.random.binomial(key, n, p).astype(jnp.int64)
+        return jax.random.binomial(key, n, p).astype(
+            dtype_mod.jax_dtype("int64"))
     return run_op("binomial", f, count, prob, differentiable=False)
 
 
@@ -153,13 +156,13 @@ def exponential_(x, lam=1.0, name=None):
 
 def rand_like(x, dtype=None, name=None):
     key = gen_mod.next_key()
-    d = dtype_mod.convert_dtype(dtype) or x.dtype
+    d = dtype_mod.jax_dtype(dtype) or x.dtype
     return Tensor._wrap(jax.random.uniform(key, tuple(x.shape), d))
 
 
 def randn_like(x, dtype=None, name=None):
     key = gen_mod.next_key()
-    d = dtype_mod.convert_dtype(dtype) or x.dtype
+    d = dtype_mod.jax_dtype(dtype) or x.dtype
     return Tensor._wrap(jax.random.normal(key, tuple(x.shape), d))
 
 

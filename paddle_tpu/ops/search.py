@@ -11,7 +11,7 @@ from paddle_tpu.core.tensor import Tensor
 
 
 def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
-    d = dtype_mod.convert_dtype(dtype)
+    d = dtype_mod.jax_dtype(dtype)
     def f(a):
         out = jnp.argmax(a.reshape(-1) if axis is None else a,
                          axis=0 if axis is None else axis,
@@ -21,7 +21,7 @@ def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
 
 
 def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
-    d = dtype_mod.convert_dtype(dtype)
+    d = dtype_mod.jax_dtype(dtype)
     def f(a):
         out = jnp.argmin(a.reshape(-1) if axis is None else a,
                          axis=0 if axis is None else axis,
@@ -34,7 +34,7 @@ def argsort(x, axis=-1, descending=False, stable=True, name=None):
     def f(a):
         idx = jnp.argsort(a, axis=axis, stable=stable,
                           descending=descending)
-        return idx.astype(jnp.int64)
+        return idx.astype(dtype_mod.jax_dtype("int64"))
     return run_op("argsort", f, x, differentiable=False)
 
 
@@ -55,7 +55,7 @@ def topk(x, k, axis=None, largest=True, sorted=True, name=None):
             vals, idx = jax.lax.top_k(-moved, k)
             vals = -vals
         return (jnp.moveaxis(vals, -1, ax),
-                jnp.moveaxis(idx.astype(jnp.int64), -1, ax))
+                jnp.moveaxis(idx.astype(dtype_mod.jax_dtype("int64")), -1, ax))
     return run_op("topk", f, x)
 
 
@@ -65,7 +65,7 @@ def kthvalue(x, k, axis=-1, keepdim=False, name=None):
         sorted_a = jnp.sort(a, axis=ax)
         sorted_i = jnp.argsort(a, axis=ax)
         vals = jnp.take(sorted_a, k - 1, axis=ax)
-        idx = jnp.take(sorted_i, k - 1, axis=ax).astype(jnp.int64)
+        idx = jnp.take(sorted_i, k - 1, axis=ax).astype(dtype_mod.jax_dtype("int64"))
         if keepdim:
             vals = jnp.expand_dims(vals, ax)
             idx = jnp.expand_dims(idx, ax)
@@ -85,7 +85,7 @@ def mode(x, axis=-1, keepdim=False, name=None):
         best = jnp.argmax(cnt, axis=-1)
         vals = jnp.take_along_axis(s, best[..., None], axis=-1)[..., 0]
         idx = jnp.argmax((moved == vals[..., None]).astype(jnp.int32),
-                         axis=-1).astype(jnp.int64)
+                         axis=-1).astype(dtype_mod.jax_dtype("int64"))
         if keepdim:
             vals = jnp.expand_dims(vals, -1)
             idx = jnp.expand_dims(idx, -1)
@@ -109,15 +109,15 @@ def nonzero(x, as_tuple=False):
     arr = np.asarray(x._data)
     nz = np.nonzero(arr)
     if as_tuple:
-        return tuple(Tensor._wrap(jnp.asarray(i, jnp.int64).reshape(-1, 1))
+        return tuple(Tensor._wrap(jnp.asarray(i, dtype_mod.jax_dtype("int64")).reshape(-1, 1))
                      for i in nz)
-    return Tensor._wrap(jnp.asarray(np.stack(nz, -1), jnp.int64))
+    return Tensor._wrap(jnp.asarray(np.stack(nz, -1), dtype_mod.jax_dtype("int64")))
 
 
 def searchsorted(sorted_sequence, values, out_int32=False, right=False,
                  name=None):
     side = "right" if right else "left"
-    d = jnp.int32 if out_int32 else jnp.int64
+    d = jnp.int32 if out_int32 else dtype_mod.jax_dtype("int64")
     def f(seq, v):
         if seq.ndim == 1:
             return jnp.searchsorted(seq, v, side=side).astype(d)
@@ -203,11 +203,11 @@ def unique_consecutive(x, return_inverse=False, return_counts=False,
     results = [Tensor._wrap(jnp.asarray(out))]
     if return_inverse:
         inv = np.cumsum(take) - 1
-        results.append(Tensor._wrap(jnp.asarray(inv, np.int64)))
+        results.append(Tensor._wrap(jnp.asarray(inv, dtype_mod.jax_dtype("int64"))))
     if return_counts:
         idx = np.nonzero(take)[0]
         counts = np.diff(np.append(idx, arr.shape[ax]))
-        results.append(Tensor._wrap(jnp.asarray(counts, np.int64)))
+        results.append(Tensor._wrap(jnp.asarray(counts, dtype_mod.jax_dtype("int64"))))
     return results[0] if len(results) == 1 else tuple(results)
 
 
@@ -264,8 +264,12 @@ def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1, k=0,
         # x is a probability distribution per row (reference
         # tensor/search.py top_p_sampling contract — NOT logits);
         # normalize defensively so un-normalized input still works
-        probs = probs_in.astype(jnp.float32)
-        probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+        probs = jnp.maximum(probs_in.astype(jnp.float32), 0.0)
+        # guard the normalizer: a caller passing logits (all-negative
+        # rows clamp to zero mass) gets a uniform draw, not NaN garbage
+        total = jnp.sum(probs, axis=-1, keepdims=True)
+        probs = jnp.where(total > 0, probs / jnp.maximum(total, 1e-38),
+                          1.0 / probs.shape[-1])
         order = jnp.argsort(-probs, axis=-1)
         sorted_p = jnp.take_along_axis(probs, order, axis=-1)
         cum = jnp.cumsum(sorted_p, axis=-1)
@@ -278,7 +282,7 @@ def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1, k=0,
             jnp.maximum(masked, 1e-38)), axis=-1)
         ids = jnp.take_along_axis(order, draw[:, None], axis=-1)
         scores = jnp.take_along_axis(probs, ids, axis=-1)
-        return scores.astype(probs_in.dtype), ids.astype(jnp.int64)
+        return scores.astype(probs_in.dtype), ids.astype(dtype_mod.jax_dtype("int64"))
 
     out = run_op("top_p_sampling", f, x, ps, n_outputs=2,
                  differentiable=False)

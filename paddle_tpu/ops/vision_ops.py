@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from paddle_tpu.core import dtype as dtype_mod
 from paddle_tpu.core.dispatch import run_op
 from paddle_tpu.core.tensor import Tensor
 
@@ -300,7 +301,7 @@ def max_pool2d_with_index(x, kernel_size, strides=None, paddings=(0, 0),
         kx = arg % kw_
         iy = jnp.clip(oy[None, None] + ky, 0, h - 1)
         ix = jnp.clip(ox[None, None] + kx, 0, w - 1)
-        return val, (iy * w + ix).astype(jnp.int64)
+        return val, (iy * w + ix).astype(dtype_mod.jax_dtype("int64"))
     return run_op("max_pool2d_with_index", f, _t(x))
 
 

@@ -5,3 +5,4 @@ from .optimizers import (  # noqa: F401
     SGD, ASGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, LBFGS,
     Momentum, NAdam, RAdam, RMSProp, Rprop,
 )
+from .gradient_merge import GradientMergeOptimizer  # noqa: F401

@@ -228,6 +228,37 @@ def test_int8_native_serving_export_roundtrip(tmp_path):
                                atol=2e-4)
 
 
+def test_cache_overflow_raises_eagerly():
+    from paddle_tpu.inference.decode import (init_static_cache,
+                                             cache_attention)
+    import jax.numpy as jnp
+    cache = init_static_cache(1, 4, 2, 8)
+    cache = cache._replace(length=paddle.to_tensor(
+        np.array([4], np.int32)))
+    q = paddle.randn([1, 1, 2, 8])
+    with pytest.raises(ValueError, match="overflow"):
+        cache_attention(q, q, q, cache)
+
+
+def test_eos_pins_finished_sequences():
+    from paddle_tpu.inference.decode import DecodeSession
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    paddle.seed(0)
+    m = LlamaForCausalLM(LlamaConfig.tiny())
+    m.eval()
+    ids = paddle.randint(0, 256, [2, 6])
+    # discover what greedy decoding emits at step 2 for sequence 0, then
+    # declare that token the eos: everything after must be pinned to it
+    probe = DecodeSession(m, 32).generate(ids, max_new_tokens=6).numpy()
+    eos = int(probe[0, 7])
+    sess = DecodeSession(m, 32, eos_token_id=eos)
+    out = sess.generate(ids, max_new_tokens=6).numpy()
+    gen0 = out[0, 6:]
+    hit = np.argmax(gen0 == eos)
+    assert gen0[hit] == eos
+    assert (gen0[hit:] == eos).all(), gen0
+
+
 def test_predictor_generate_serving(tiny_llama):
     from paddle_tpu import inference
     cfg = inference.Config()
