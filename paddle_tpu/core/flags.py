@@ -77,5 +77,12 @@ define_flag("FLAGS_benchmark", False, "block on every op for timing")
 define_flag("FLAGS_log_level", 0, "framework verbosity")
 define_flag("FLAGS_eager_op_cache", True,
             "cache per-op compiled executables in eager mode")
+define_flag("FLAGS_kv_capacity_check", True,
+            "eager KV-cache overflow guard in the decode path (one tiny "
+            "device sync per eager step; traced/serving paths unaffected)")
+define_flag("FLAGS_collective_matmul", False,
+            "SP linears use ring-overlapped collective matmuls "
+            "(all_gather@W / X@W->reduce_scatter) instead of GSPMD "
+            "constraint resharding")
 define_flag("FLAGS_collective_timeout_s", 600.0,
             "collective watchdog timeout seconds")

@@ -170,6 +170,10 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
     regions. A sharded eager input is gathered to replicated (its global
     value is unchanged; no reduction is performed)."""
     if deferral_active():
+        # NOTE: deduped by tensor identity — callers syncing a tensor
+        # that is REPLACED each microbatch (param grads) must defer at
+        # their own level keyed by the stable owner instead
+        # (fused_allreduce_gradients does; stage-2 hooks do)
         _defer_stack[-1].add(("all_reduce", id(tensor), id(group)),
                              lambda: all_reduce(tensor, op, group,
                                                 sync_op))

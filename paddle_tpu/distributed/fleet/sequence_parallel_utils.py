@@ -27,6 +27,18 @@ from paddle_tpu.nn.layer.layers import Layer
 from .mp_layers import _constrain, _mp_axis, _put
 
 
+def _use_collective_matmul(mesh, axis):
+    """Collective matmul is opt-in (FLAGS_collective_matmul or the
+    hybrid engine's ParallelConfig.collective_matmul) and needs a real
+    mp axis to ring over."""
+    if mesh is None or axis is None:
+        return False
+    if mesh.get_dim_size(axis) <= 1:
+        return False
+    from paddle_tpu.core.flags import get_flag
+    return bool(get_flag("FLAGS_collective_matmul"))
+
+
 def _seq_spec(ndim, seq_dim=1):
     spec = [None] * ndim
     spec[seq_dim] = "mp"
@@ -90,8 +102,18 @@ class ColumnSequenceParallelLinear(Layer):
 
     def forward(self, x):
         def f(a, w, *b):
-            a = _constrain(a, P(*([None] * a.ndim)))  # seq allgather
-            out = jnp.matmul(a, w)
+            mesh, axis = _mp_axis()
+            if _use_collective_matmul(mesh, axis) and a.ndim == 3:
+                # ring-overlapped allgather@W: each scan step multiplies
+                # the resident seq shard while the next permutes over
+                # ICI (reference sequence_parallel_utils.py:240-340
+                # overlap, the TPU way)
+                from paddle_tpu.parallel.collective_matmul import \
+                    sp_column_matmul
+                out = sp_column_matmul(a, w, mesh.jax_mesh, axis)
+            else:
+                a = _constrain(a, P(*([None] * a.ndim)))  # seq allgather
+                out = jnp.matmul(a, w)
             if b:
                 out = out + b[0]
             spec = [None] * out.ndim
@@ -125,10 +147,20 @@ class RowSequenceParallelLinear(Layer):
 
     def forward(self, x):
         def f(a, w, *b):
-            if self.input_is_parallel:
-                a = _constrain(a, P(*([None] * (a.ndim - 1) + ["mp"])))
-            out = jnp.matmul(a, w)
-            out = _constrain(out, _seq_spec(out.ndim, 1))  # reduce-scatter
+            mesh, axis = _mp_axis()
+            if _use_collective_matmul(mesh, axis) and a.ndim == 3 and \
+                    self.input_is_parallel:
+                # X@W -> ring reduce-scatter: the partial-sum tile
+                # rotates while the next block computes
+                from paddle_tpu.parallel.collective_matmul import \
+                    sp_row_matmul
+                out = sp_row_matmul(a, w, mesh.jax_mesh, axis)
+            else:
+                if self.input_is_parallel:
+                    a = _constrain(a, P(*([None] * (a.ndim - 1)
+                                          + ["mp"])))
+                out = jnp.matmul(a, w)
+                out = _constrain(out, _seq_spec(out.ndim, 1))  # r-scatter
             if b:
                 out = out + b[0]
             return out

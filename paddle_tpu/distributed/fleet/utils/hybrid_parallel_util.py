@@ -37,12 +37,18 @@ def fused_allreduce_gradients_with_group(parameter_list, group,
     the global mean (DataParallel.scale_loss), so no implicit divide —
     an explicit `scale` is still honored for callers that pre-scaled."""
     for p in parameter_list:
-        g = getattr(p, "grad", None)
-        if g is None:
-            continue
-        C.all_reduce(g, group=group)
-        if scale and scale != 1:
-            g._assign_array(g._data / scale)
+        def sync(p=p):
+            g = getattr(p, "grad", None)
+            if g is None:
+                return
+            C.all_reduce(g, group=group)
+            if scale and scale != 1:
+                g._assign_array(g._data / scale)
+        # keyed by PARAM so an accumulation window (no_sync) records one
+        # deferred sync per param that re-reads p.grad at exit — grads
+        # are fresh Tensors every backward, so keying by the grad would
+        # pin stale arrays and replay k times
+        C.defer_or_run(("fused_allreduce", id(p), id(group)), sync)
 
 
 def fused_allreduce_gradients(parameter_list, hcg):

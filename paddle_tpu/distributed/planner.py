@@ -83,12 +83,34 @@ class PlanCandidate:
     est_mem_bytes: float = math.inf
     breakdown: Dict[str, float] = field(default_factory=dict)
 
+    @property
+    def collective_matmul(self) -> bool:
+        """Ring-overlap knob for the sp matmuls: recommended whenever
+        the plan sequence-parallelizes over a real tp axis at pp==1
+        (the supported overlap region — gpt_hybrid._use_cm). Consumed
+        by to_parallel_config()."""
+        return self.sp and self.tp > 1 and self.pp == 1
+
+    def to_parallel_config(self, **overrides):
+        """Materialize this plan as a hybrid-engine ParallelConfig
+        (models/gpt_hybrid.py), carrying the collective_matmul knob and
+        the zero/microbatch/remat choices. Extra kwargs override."""
+        from paddle_tpu.models.gpt_hybrid import ParallelConfig
+        kw = dict(dp=self.dp, tp=self.tp, pp=self.pp, sp=self.sp,
+                  microbatches=self.microbatches,
+                  pp_schedule="1f1b" if self.pp > 1 else "gpipe",
+                  remat=self.remat, zero1=self.zero >= 1,
+                  collective_matmul=self.collective_matmul)
+        kw.update(overrides)
+        return ParallelConfig(**kw)
+
     def short(self) -> str:
         return (f"dp{self.dp}xtp{self.tp}xpp{self.pp}"
                 f"{'+sp' if self.sp else ''}"
                 f"{f'+zero{self.zero}' if self.zero else ''}"
                 f"{'' if self.remat else '+noremat'}"
-                f"{f'+mb{self.microbatches}' if self.pp > 1 else ''}")
+                f"{f'+mb{self.microbatches}' if self.pp > 1 else ''}"
+                f"{'+cm' if self.collective_matmul else ''}")
 
 
 from paddle_tpu.distributed.auto_tuner import _divisors  # noqa: E402

@@ -93,15 +93,23 @@ def _cache_attention(q, kn, vn, kbuf, vbuf, lens):
 def _check_capacity(length, s_new, capacity):
     """Eager misuse guard: writing past capacity would silently clamp
     (dynamic_update_slice semantics) and corrupt the newest cache slot.
-    Lengths are concrete in eager mode — check them; under a trace the
-    DecodeSession has already sized the cache."""
+    Lengths are concrete in eager mode — check them; under a trace
+    (DecodeSession / user jit) lengths are tracers and this is a no-op,
+    so the compiled serving path pays nothing. The eager check costs one
+    tiny device sync per step; disable with
+    FLAGS_kv_capacity_check=false when an eager loop is latency-bound
+    and externally guarded."""
     arr = length._data if isinstance(length, Tensor) else length
-    if not isinstance(arr, jax.core.Tracer):
-        top = int(jax.device_get(jnp.max(arr))) + s_new
-        if top > capacity:
-            raise ValueError(
-                f"KV cache overflow: writing {s_new} token(s) at length "
-                f"{top - s_new} exceeds capacity {capacity}")
+    if isinstance(arr, jax.core.Tracer):
+        return
+    from paddle_tpu.core.flags import get_flag
+    if not get_flag("FLAGS_kv_capacity_check"):
+        return
+    top = int(jax.device_get(jnp.max(arr))) + s_new
+    if top > capacity:
+        raise ValueError(
+            f"KV cache overflow: writing {s_new} token(s) at length "
+            f"{top - s_new} exceeds capacity {capacity}")
 
 
 def cache_attention(q, k_new, v_new, cache: StaticCache):

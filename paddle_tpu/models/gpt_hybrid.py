@@ -66,6 +66,15 @@ class ParallelConfig:
     # auto_parallel_gradient_merge pass, with the deferred reduction
     # falling out of XLA compiling the whole loop as one program
     gradient_merge_steps: int = 1
+    # sp matmuls become ring collective matmuls (all_gather@W and
+    # X@W->reduce_scatter decomposed inside shard_map so the ICI
+    # permute overlaps the MXU block GEMMs — parallel/collective_matmul
+    # .py; the reference overlaps these with CUDA streams,
+    # sequence_parallel_utils.py:240-340). Opt-in: wins only when the
+    # gather/scatter is bandwidth-bound on real multi-chip ICI.
+    # Applies when pp == 1 (Shardy cannot nest the tp-manual ring
+    # inside the pp-manual 1F1B region — see _use_cm)
+    collective_matmul: bool = False
     zero1: bool = True        # shard adam moments over dp
     fused_ce: bool = True     # chunked LM-head+CE (ops/fused_ce.py);
                               # never materializes [T, V] logits
@@ -236,22 +245,60 @@ def _moe_ffn(x, lp, pcfg, mesh):
     return combined.reshape(b, s, h)
 
 
+def _use_cm(pcfg):
+    # pp>1 exclusion is a Shardy nesting limit, not a design choice: the
+    # inner tp-manual shard_map inside the pp-manual 1F1B region trips
+    # sdy's "manual axes must precede free axes" verifier on captured
+    # operands varying over (pp, tp). Ring-overlap therefore applies on
+    # pure tp/sp (+dp) configs; pp stages fall back to GSPMD constraint
+    # resharding.
+    return pcfg.collective_matmul and pcfg.sp and pcfg.tp > 1 \
+        and pcfg.pp == 1
+
+
+def _cm_column(x, w, b, mesh):
+    """allgather(x, seq)@W as a ring collective matmul over 'tp'."""
+    from paddle_tpu.parallel.collective_matmul import sp_column_matmul
+    return sp_column_matmul(x, w, mesh, "tp") + b
+
+
+def _cm_row(x, w, b, mesh):
+    """X@W -> ring reduce_scatter onto the seq dim over 'tp'."""
+    from paddle_tpu.parallel.collective_matmul import sp_row_matmul
+    return sp_row_matmul(x, w, mesh, "tp") + b
+
+
 def _block(x, lp, cfg, pcfg, mesh):
     from jax.ad_checkpoint import checkpoint_name
     act_spec = P("dp", "tp", None) if pcfg.sp else P("dp", None, None)
+    cm = _use_cm(pcfg)
     x = _constrain(x, act_spec, mesh)
     hres = x
     hx = _layer_norm(x, lp["ln1_g"], lp["ln1_b"])
-    qkv = checkpoint_name(hx @ lp["qkv_w"] + lp["qkv_b"], "qkv")
+    if cm:
+        qkv = checkpoint_name(
+            _cm_column(hx, lp["qkv_w"], lp["qkv_b"], mesh), "qkv")
+    else:
+        qkv = checkpoint_name(hx @ lp["qkv_w"] + lp["qkv_b"], "qkv")
     q, k, v = jnp.split(qkv, 3, axis=-1)
     attn = checkpoint_name(_attend(q, k, v, cfg.num_heads), "attn_out")
-    attn = checkpoint_name(attn @ lp["proj_w"] + lp["proj_b"], "proj")
+    if cm:
+        attn = checkpoint_name(
+            _cm_row(attn, lp["proj_w"], lp["proj_b"], mesh), "proj")
+    else:
+        attn = checkpoint_name(attn @ lp["proj_w"] + lp["proj_b"],
+                               "proj")
     x = hres + attn
     x = _constrain(x, act_spec, mesh)
     hres = x
     hx = _layer_norm(x, lp["ln2_g"], lp["ln2_b"])
     if pcfg.num_experts > 0:
         ff = _moe_ffn(hx, lp, pcfg, mesh)
+    elif cm:
+        ff = checkpoint_name(
+            _cm_row(jax.nn.gelu(checkpoint_name(
+                _cm_column(hx, lp["fc1_w"], lp["fc1_b"], mesh),
+                "ffn1")), lp["fc2_w"], lp["fc2_b"], mesh), "ffn2")
     else:
         ff = checkpoint_name(
             jax.nn.gelu(checkpoint_name(

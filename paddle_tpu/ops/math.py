@@ -275,11 +275,8 @@ def cumprod(x, dim=None, dtype=None, name=None):
 
 def _index_dtype(dtype):
     """int64 only when jax x64 is actually enabled; canonical int32
-    otherwise (avoids jax's warn-and-truncate on int64 requests)."""
-    d = dtype_mod.jax_dtype(dtype if dtype is not None else "int64")
-    if d == np.int64 and not jax.config.jax_enable_x64:
-        return jnp.int32
-    return d
+    otherwise — jax_dtype IS that policy."""
+    return dtype_mod.jax_dtype(dtype if dtype is not None else "int64")
 
 
 def cummax(x, axis=None, dtype="int64", name=None):

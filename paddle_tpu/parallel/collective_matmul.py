@@ -29,6 +29,22 @@ def _fwd_perm(n):
     return [(i, (i + 1) % n) for i in range(n)]
 
 
+def _zeros_like_vma(shape, dtype, ref, axis_name):
+    """Zeros whose varying-manual-axes spec covers {axis_name} UNION
+    ref's vma: a scan carry must type-match the body output, and when
+    these primitives run nested inside another manual region (e.g. the
+    1F1B pp shard_map) the blocks inherit extra varying axes from the
+    inputs."""
+    z = jnp.zeros(shape, dtype)
+    try:
+        want = set(jax.typeof(ref).vma) | {axis_name}
+        have = set(jax.typeof(z).vma)
+        missing = tuple(sorted(want - have))
+    except Exception:
+        missing = (axis_name,)
+    return lax.pcast(z, missing, to="varying") if missing else z
+
+
 def all_gather_matmul(x, w, axis_name: str):
     """Computes all_gather(x, axis) @ w without materializing the
     gather: x [s, ...k] is this device's shard along the FIRST dim of
@@ -41,9 +57,9 @@ def all_gather_matmul(x, w, axis_name: str):
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     s = x.shape[0]
-    out = lax.pcast(jnp.zeros((n * s, w.shape[-1]),
-                              jnp.promote_types(x.dtype, w.dtype)),
-                    (axis_name,), to="varying")
+    out = _zeros_like_vma((n * s,) + x.shape[1:-1] + (w.shape[-1],),
+                          jnp.promote_types(x.dtype, w.dtype), x,
+                          axis_name)
 
     def step(carry, i):
         x_cur, out = carry
@@ -76,9 +92,9 @@ def matmul_reduce_scatter(x, w, axis_name: str):
     if m % n != 0:
         raise ValueError(f"rows {m} not divisible by axis size {n}")
     s = m // n
-    acc = lax.pcast(jnp.zeros((s, w.shape[-1]),
-                              jnp.promote_types(x.dtype, w.dtype)),
-                    (axis_name,), to="varying")
+    acc = _zeros_like_vma((s,) + x.shape[1:-1] + (w.shape[-1],),
+                          jnp.promote_types(x.dtype, w.dtype), x,
+                          axis_name)
 
     def block_for(dest):
         xs = lax.dynamic_slice_in_dim(x, dest * s, s, 0)
@@ -94,3 +110,52 @@ def matmul_reduce_scatter(x, w, axis_name: str):
 
     acc, _ = lax.scan(step, acc, jnp.arange(n - 1))
     return acc + block_for(idx)
+
+
+# ---------------------------------------------------------------------------
+# SP-layout wrappers: the building blocks above operate on a first-dim
+# shard; sequence parallelism shards dim 1 of [B, S, ...] activations.
+# These close the gap and are what the SP linears / hybrid engine call
+# when collective matmul is enabled (VERDICT r2 item 4).
+# ---------------------------------------------------------------------------
+
+def sp_column_matmul_local(x_local, w_local, axis_name: str):
+    """Per-device body for allgather(x, seq)@W: x_local [B, S/n, K]
+    (sequence shard), w_local [K, F/n] (column shard) ->
+    [B, S, F/n]."""
+    xt = jnp.swapaxes(x_local, 0, 1)              # [S/n, B, K]
+    ot = all_gather_matmul(xt, w_local, axis_name)  # [S, B, F/n]
+    return jnp.swapaxes(ot, 0, 1)
+
+
+def sp_row_matmul_local(x_local, w_local, axis_name: str):
+    """Per-device body for reduce_scatter(x@W, seq): x_local [B, S, K/n]
+    (feature shard), w_local [K/n, F] (row shard) -> [B, S/n, F]."""
+    xt = jnp.swapaxes(x_local, 0, 1)              # [S, B, K/n]
+    ot = matmul_reduce_scatter(xt, w_local, axis_name)  # [S/n, B, F]
+    return jnp.swapaxes(ot, 0, 1)
+
+
+def sp_column_matmul(x, w, mesh, axis_name="mp"):
+    """Global-array form (eager or jit): x [B, S, K] sequence-sharded
+    over `axis_name`, w [K, F] column-sharded. Ring-overlapped; output
+    [B, S, F] gathered on S, sharded on F."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+    return shard_map(
+        lambda a, b: sp_column_matmul_local(a, b, axis_name),
+        mesh=mesh, axis_names={axis_name},
+        in_specs=(P(None, axis_name, None), P(None, axis_name)),
+        out_specs=P(None, None, axis_name))(x, w)
+
+
+def sp_row_matmul(x, w, mesh, axis_name="mp"):
+    """Global-array form: x [B, S, K] feature-sharded over `axis_name`,
+    w [K, F] row-sharded. Output [B, S, F] sequence-sharded on S."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+    return shard_map(
+        lambda a, b: sp_row_matmul_local(a, b, axis_name),
+        mesh=mesh, axis_names={axis_name},
+        in_specs=(P(None, None, axis_name), P(axis_name, None)),
+        out_specs=P(None, axis_name, None))(x, w)

@@ -117,3 +117,92 @@ def test_grad_flows_through_ring_matmuls():
     for a, b in zip(g_ring, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Round 3: collective matmul WIRED into the SP linears and the hybrid
+# engine (VERDICT r2 item 4) — parity with the constraint path, flag on.
+# ---------------------------------------------------------------------------
+def test_sp_linears_with_collective_matmul_match_constraint_path():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.distributed.fleet import (
+        ColumnSequenceParallelLinear, RowSequenceParallelLinear)
+    from paddle_tpu.distributed.fleet.sequence_parallel_utils import (
+        all_gather, scatter)
+    from paddle_tpu.distributed.mesh import ProcessMesh, set_mesh
+
+    mesh = ProcessMesh(shape=[4], dim_names=["mp"])
+    set_mesh(mesh)
+    try:
+        paddle.seed(11)
+        col = ColumnSequenceParallelLinear(16, 32, gather_output=False)
+        row = RowSequenceParallelLinear(32, 16, input_is_parallel=True)
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(2, 8, 16).astype("float32"))
+        xs = scatter(x)
+
+        set_flags({"FLAGS_collective_matmul": False})
+        y_ref = all_gather(row(col(xs))).numpy()
+
+        set_flags({"FLAGS_collective_matmul": True})
+        y_cm = all_gather(row(col(xs))).numpy()
+        np.testing.assert_allclose(y_cm, y_ref, rtol=1e-4, atol=1e-5)
+    finally:
+        set_flags({"FLAGS_collective_matmul": False})
+        set_mesh(None)
+
+
+def test_sp_linears_collective_matmul_autodiff():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.distributed.fleet import ColumnSequenceParallelLinear
+    from paddle_tpu.distributed.fleet.sequence_parallel_utils import \
+        scatter
+    from paddle_tpu.distributed.mesh import ProcessMesh, set_mesh
+
+    mesh = ProcessMesh(shape=[4], dim_names=["mp"])
+    set_mesh(mesh)
+    try:
+        grads = {}
+        for flag in (False, True):
+            set_flags({"FLAGS_collective_matmul": flag})
+            paddle.seed(3)
+            col = ColumnSequenceParallelLinear(16, 32,
+                                               gather_output=False)
+            x = paddle.to_tensor(np.random.RandomState(2).randn(
+                2, 8, 16).astype("float32"))
+            xs = scatter(x)
+            loss = paddle.mean(col(xs) ** 2)
+            loss.backward()
+            grads[flag] = col.weight.grad.numpy()
+        np.testing.assert_allclose(grads[True], grads[False],
+                                   rtol=1e-4, atol=1e-5)
+    finally:
+        set_flags({"FLAGS_collective_matmul": False})
+        set_mesh(None)
+
+
+def test_hybrid_engine_collective_matmul_loss_parity():
+    """dp1 x tp4 + sp with collective_matmul on vs off: compiled train
+    step loss parity (the one-flag-flip multi-chip readiness check)."""
+    import numpy as np
+    import jax
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.models.gpt_hybrid import ParallelConfig, setup
+    cfg = GPTConfig.tiny()
+    ids = np.random.default_rng(3).integers(0, 256, (4, 16))
+    losses = {}
+    for cm in (False, True):
+        pcfg = ParallelConfig(dp=1, pp=1, tp=4, sp=True,
+                              collective_matmul=cm, remat=False)
+        mesh, params, opt_state, step = setup(cfg, pcfg, seed=0,
+                                              devices=jax.devices()[:4])
+        with mesh:
+            params, opt_state, loss = step(params, opt_state, (ids, ids))
+            params, opt_state, loss2 = step(params, opt_state,
+                                            (ids, ids))
+        losses[cm] = (float(loss), float(loss2))
+    np.testing.assert_allclose(losses[True], losses[False], rtol=2e-4)

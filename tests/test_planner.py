@@ -134,3 +134,15 @@ def test_model_spec_from_config():
     spec = ModelSpec.from_config(cfg)
     # parameter-count formula lands near the real 1.3B
     assert 1.1e9 < spec.n_params < 1.6e9, spec.n_params
+
+
+def test_plan_to_parallel_config_carries_collective_matmul():
+    from paddle_tpu.distributed.planner import PlanCandidate
+    p = PlanCandidate(dp=2, tp=4, pp=1, sp=True, zero=1, microbatches=1)
+    pcfg = p.to_parallel_config()
+    assert pcfg.collective_matmul and pcfg.zero1 and pcfg.tp == 4
+    assert "+cm" in p.short()
+    p2 = PlanCandidate(dp=1, tp=4, pp=2, sp=True, microbatches=4)
+    pcfg2 = p2.to_parallel_config(remat=False)
+    assert not pcfg2.collective_matmul and pcfg2.pp_schedule == "1f1b"
+    assert pcfg2.remat is False
