@@ -48,6 +48,16 @@ class _AmpState:
 _STATE = _AmpState()
 
 
+_LOW_PRECISION_OPS = {}
+
+
+def _record_low_precision(name, dt):
+    from paddle_tpu.core.flags import get_flag
+    if get_flag("FLAGS_low_precision_op_list"):
+        key = f"{name}->{np.dtype(dt).name}"
+        _LOW_PRECISION_OPS[key] = _LOW_PRECISION_OPS.get(key, 0) + 1
+
+
 def _amp_hook(name, arrays):
     st = _STATE
     if not st.enabled or st.level == "O0":
@@ -63,10 +73,12 @@ def _amp_hook(name, arrays):
                 for a in arrs]
 
     if name in white:
+        _record_low_precision(name, target)
         return cast_to(arrays, target)
     if name in black:
         return cast_to(arrays, jnp.float32)
     if st.level == "O2" and name not in black:
+        _record_low_precision(name, target)
         return cast_to(arrays, target)
     # O1 gray list: promote to widest float among inputs
     f_dtypes = [a.dtype for a in arrays

@@ -128,3 +128,17 @@ def compare_accuracy(dump_path, another_dump_path, output_filename,
     raise NotImplementedError(
         "compare_accuracy consumes GPU dump files; on TPU compare runs "
         "with paddle_tpu.utils.run_check-style numpy oracles instead")
+
+
+def get_low_precision_op_list():
+    """Ops auto-cast to low precision by AMP since
+    FLAGS_low_precision_op_list was enabled (reference
+    amp/debugging.py low-precision op collection): {"op->dtype": count}.
+    """
+    from paddle_tpu.amp import _LOW_PRECISION_OPS
+    return dict(_LOW_PRECISION_OPS)
+
+
+def clear_low_precision_op_list():
+    from paddle_tpu.amp import _LOW_PRECISION_OPS
+    _LOW_PRECISION_OPS.clear()

@@ -189,6 +189,8 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
         ran.add(id(node))
         node._ensure_buf()
         # per-output tensor hooks (register_hook on non-leaf tensors)
+        from paddle_tpu.core.flags import get_flag as _gf
+        retain_all = _gf("FLAGS_retain_grad_for_all")
         for i, ref in enumerate(node.out_refs):
             if ref is None or node._buf[i] is None:
                 continue
@@ -200,6 +202,9 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False,
                     if out is not None:
                         g = out._data if isinstance(out, Tensor) else out
                 node._buf[i] = g
+            if retain_all and t is not None:
+                # debugging: expose intermediate grads (retain_grads)
+                t.grad = Tensor._wrap(node._buf[i], stop_gradient=True)
         if targets is not None:
             for i in range(len(node.out_avals)):
                 tt = target_by_slot.get((id(node), i))

@@ -51,7 +51,7 @@ def add_op_observer(cb):
     return lambda: _op_observers.remove(cb)
 
 
-def _check_nan_inf(name, arrays):
+def _check_nan_inf(name, arrays, in_arrays=()):
     level = get_flag("FLAGS_check_nan_inf_level")
     for a in arrays:
         if not jnp.issubdtype(a.dtype, jnp.inexact):
@@ -62,6 +62,22 @@ def _check_nan_inf(name, arrays):
             return  # tracer — checked at runtime only in eager mode
         if bad:
             msg = f"NaN/Inf detected in output of op '{name}'"
+            dump_dir = get_flag("FLAGS_nan_inf_dump_dir")
+            if dump_dir:
+                # dump the offending op's operands for post-mortem
+                # (check_nan_inf_level dump behavior in the reference)
+                import os
+                import time as _time
+                import numpy as _np
+                os.makedirs(dump_dir, exist_ok=True)
+                path = os.path.join(
+                    dump_dir, f"naninf_{name}_{int(_time.time()*1e3)}")
+                _np.savez(path,
+                          **{f"in{i}": _np.asarray(x)
+                             for i, x in enumerate(in_arrays)},
+                          **{f"out{i}": _np.asarray(x)
+                             for i, x in enumerate(arrays)})
+                msg += f" (operands dumped to {path}.npz)"
             if level >= 3:
                 print("[check_nan_inf]", msg)
             else:
@@ -96,16 +112,32 @@ def run_op(name: str, fn: Callable, *inputs, n_outputs=None, amp=True,
     needs = [t is not None and _differentiable(t) for t in in_tensors]
     record = differentiable and _grad_enabled and any(needs)
 
-    if record:
-        out_arrays, vjp_fn = jax.vjp(fn, *arrays)
-    else:
-        out_arrays = fn(*arrays)
+    try:
+        if record:
+            out_arrays, vjp_fn = jax.vjp(fn, *arrays)
+        else:
+            out_arrays = fn(*arrays)
+    except Exception as e:
+        if get_flag("FLAGS_call_stack_level") >= 2:
+            sig = ", ".join(f"{a.dtype}{list(a.shape)}" for a in arrays)
+            raise RuntimeError(
+                f"op '{name}' failed (inputs: {sig}): "
+                f"{type(e).__name__}: {e}") from e
+        raise
 
     single = not isinstance(out_arrays, (tuple, list))
     outs = (out_arrays,) if single else tuple(out_arrays)
 
+    if get_flag("FLAGS_op_log"):
+        filt = get_flag("FLAGS_op_log_filter")
+        if not filt or filt in (name or ""):
+            import sys as _sys
+            ins = ",".join(f"{a.dtype}{list(a.shape)}" for a in arrays)
+            os_ = ",".join(f"{a.dtype}{list(a.shape)}" for a in outs)
+            print(f"[op] {name}({ins}) -> {os_}", file=_sys.stderr)
+
     if get_flag("FLAGS_check_nan_inf"):
-        _check_nan_inf(name, outs)
+        _check_nan_inf(name, outs, arrays)
     for cb in _op_observers:
         cb(name, outs)
 

@@ -86,3 +86,100 @@ define_flag("FLAGS_collective_matmul", False,
             "constraint resharding")
 define_flag("FLAGS_collective_timeout_s", 600.0,
             "collective watchdog timeout seconds")
+
+# --- debugging / determinism surface (round 3: the actionable subset of
+# the reference's 178 flags, each with a real effect + an effect test in
+# tests/test_flags_effects.py) -------------------------------------------
+define_flag("FLAGS_deterministic", False,
+            "deterministic mode (FLAGS_cudnn_deterministic analog): "
+            "attention autotune uses the static config (no measured "
+            "selection), matmul precision pinned to 'highest'")
+define_flag("FLAGS_matmul_precision", "",
+            "'default'|'high'|'highest' -> jax default_matmul_precision "
+            "(applied on set)")
+define_flag("FLAGS_op_log", False,
+            "log every eager op dispatch with dtypes/shapes (the VLOG "
+            "api-trace analog); see FLAGS_op_log_filter")
+define_flag("FLAGS_op_log_filter", "",
+            "substring filter for FLAGS_op_log (empty = all ops)")
+define_flag("FLAGS_nan_inf_dump_dir", "",
+            "when FLAGS_check_nan_inf trips, dump the offending op's "
+            "inputs/outputs as npz here before raising "
+            "(check_nan_inf_level dump behavior)")
+define_flag("FLAGS_collective_debug", False,
+            "log every eager collective call (op, group, shape) — the "
+            "NCCL_DEBUG analog")
+define_flag("FLAGS_watchdog_interval_s", 10.0,
+            "collective watchdog probe interval")
+define_flag("FLAGS_watchdog_store_root", "",
+            "shared dir for cross-rank watchdog progress exchange; when "
+            "set, a timeout dump names the straggler rank(s)")
+define_flag("FLAGS_print_jaxpr", False,
+            "print the traced jaxpr when to_static builds a program "
+            "(FLAGS_print_ir analog)")
+define_flag("FLAGS_max_specializations", 8,
+            "cap on cached to_static specializations per signature "
+            "before eager fallback")
+define_flag("FLAGS_retain_grad_for_all", False,
+            "keep .grad on non-leaf tensors after backward (debugging; "
+            "the retain_grads analog)")
+define_flag("FLAGS_call_stack_level", 1,
+            ">=2: eager op errors are wrapped with the op name and "
+            "input dtypes/shapes (flags.cc call_stack_level)")
+define_flag("FLAGS_memory_stats_dump_path", "",
+            "paddle.device.dump_memory_stats() target; also dumped by "
+            "the watchdog on timeout when set")
+define_flag("FLAGS_tensor_print_precision", 6,
+            "digits in Tensor repr (set_printoptions analog)")
+define_flag("FLAGS_tensor_print_threshold", 1000,
+            "summarize Tensor repr beyond this many elements")
+define_flag("FLAGS_low_precision_op_list", False,
+            "record op names auto-cast by AMP; read with "
+            "paddle.amp.debugging.get_low_precision_op_list()")
+define_flag("FLAGS_allocator_strategy", "auto_growth",
+            "'auto_growth' -> XLA_PYTHON_CLIENT_ALLOCATOR=default, "
+            "'naive_best_fit' -> =platform; honored at import (XLA "
+            "owns the allocator after backend init)")
+
+
+def _allocator_env(strategy: str) -> str:
+    """Map the reference allocator strategy names onto the XLA client
+    allocator (XLA owns allocation after backend init — honored only
+    when exported before the first device op)."""
+    return {"auto_growth": "default",
+            "naive_best_fit": "platform"}.get(strategy, "default")
+
+
+def _apply_matmul_precision(v):
+    import jax
+    jax.config.update("jax_default_matmul_precision", v or None)
+
+
+def _apply_deterministic(v):
+    import jax
+    if v:
+        jax.config.update("jax_default_matmul_precision", "highest")
+    else:
+        # restore the explicit FLAGS_matmul_precision choice (or the
+        # jax default) — disabling determinism must not leave the
+        # precision silently pinned
+        jax.config.update("jax_default_matmul_precision",
+                          get_flag("FLAGS_matmul_precision") or None)
+
+
+def _apply_allocator(v):
+    os.environ["XLA_PYTHON_CLIENT_ALLOCATOR"] = _allocator_env(v)
+
+
+on_flag_change("FLAGS_matmul_precision", _apply_matmul_precision)
+on_flag_change("FLAGS_deterministic", _apply_deterministic)
+on_flag_change("FLAGS_allocator_strategy", _apply_allocator)
+
+# env-set flags apply their side effects at import too
+if os.environ.get("FLAGS_matmul_precision"):
+    _apply_matmul_precision(get_flag("FLAGS_matmul_precision"))
+if os.environ.get("FLAGS_deterministic") and \
+        get_flag("FLAGS_deterministic"):
+    _apply_deterministic(True)
+if os.environ.get("FLAGS_allocator_strategy"):
+    _apply_allocator(get_flag("FLAGS_allocator_strategy"))

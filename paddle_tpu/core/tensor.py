@@ -269,9 +269,13 @@ class Tensor:
 
     def __repr__(self):
         sg = self.stop_gradient
+        from .flags import get_flag
         try:
-            body = np.array2string(np.asarray(self._data), precision=8,
-                                   separator=", ")
+            body = np.array2string(
+                np.asarray(self._data),
+                precision=get_flag("FLAGS_tensor_print_precision"),
+                threshold=get_flag("FLAGS_tensor_print_threshold"),
+                separator=", ")
         except Exception:
             body = f"<traced {self._data}>"
         return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "

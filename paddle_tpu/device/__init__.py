@@ -75,6 +75,28 @@ def memory_reserved(device=None):
         "pool_bytes", memory_allocated(device))
 
 
+def dump_memory_stats(path=None, device=None):
+    """Write the device memory stats as JSON to `path` (or
+    FLAGS_memory_stats_dump_path) — the reference's memory-stats dump
+    debugging surface. Returns the dict written."""
+    import json
+    from paddle_tpu.core.flags import get_flag
+    path = path or get_flag("FLAGS_memory_stats_dump_path")
+    stats = {
+        "bytes_in_use": memory_allocated(device),
+        "peak_bytes_in_use": max_memory_allocated(device),
+        "pool_bytes": memory_reserved(device),
+        "peak_pool_bytes": max_memory_reserved(device),
+        "raw": {k: v for k, v in _mem_stats(
+            _device_id(device)).items()
+            if isinstance(v, (int, float, str))},
+    }
+    if path:
+        with open(path, "w") as f:
+            json.dump(stats, f, indent=1)
+    return stats
+
+
 class cuda:
     """Namespace parity for paddle.device.cuda (maps to the active
     accelerator's stats)."""

@@ -152,6 +152,17 @@ class defer_collectives:
         return False
 
 
+def _collective_log(op, tensor, group):
+    from paddle_tpu.core.flags import get_flag
+    if get_flag("FLAGS_collective_debug"):
+        import sys
+        shape = list(tensor.shape) if hasattr(tensor, "shape") else "?"
+        gid = getattr(group, "id", "world") if group is not None \
+            else "world"
+        print(f"[collective] {op} group={gid} shape={shape}",
+              file=sys.stderr)
+
+
 def _world(group):
     return group.nranks if group is not None else get_world_size()
 
@@ -169,6 +180,7 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
     collective.ops.psum/pmax/... inside shard_map — use that in parallel
     regions. A sharded eager input is gathered to replicated (its global
     value is unchanged; no reduction is performed)."""
+    _collective_log("all_reduce", tensor, group)
     if deferral_active():
         # NOTE: deduped by tensor identity — callers syncing a tensor
         # that is REPLACED each microbatch (param grads) must defer at
@@ -188,6 +200,7 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
 
 def all_gather(tensor_list: List[Tensor], tensor: Tensor,
                group: Optional[Group] = None, sync_op=True):
+    _collective_log("all_gather", tensor, group)
     n = _world(group)
     for _ in range(n - len(tensor_list)):
         tensor_list.append(None)
@@ -203,6 +216,7 @@ def all_gather_object(object_list, obj, group=None):
 
 
 def broadcast(tensor: Tensor, src=0, group=None, sync_op=True):
+    _collective_log("broadcast", tensor, group)
     return _Task(tensor)
 
 
@@ -223,6 +237,7 @@ def scatter(tensor: Tensor, tensor_list=None, src=0, group=None,
 
 def reduce_scatter(tensor: Tensor, tensor_list, op=ReduceOp.SUM, group=None,
                    sync_op=True):
+    _collective_log("reduce_scatter", tensor, group)
     if deferral_active():
         _defer_stack[-1].add(("reduce_scatter", id(tensor), id(group)),
                              lambda: reduce_scatter(tensor, tensor_list,
@@ -235,6 +250,8 @@ def reduce_scatter(tensor: Tensor, tensor_list, op=ReduceOp.SUM, group=None,
 
 
 def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
+    _collective_log("alltoall", in_tensor_list[0] if in_tensor_list
+                    else None, group)
     out_tensor_list.clear()
     out_tensor_list.extend([Tensor._wrap(t._data) for t in in_tensor_list])
     return _Task(None)

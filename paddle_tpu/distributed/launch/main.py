@@ -37,13 +37,20 @@ def _parse():
                         "the whole job up to N times (reference: "
                         "ElasticManager relaunch / launch controllers' "
                         "replica policy)")
+    p.add_argument("--np_range", default=None, metavar="MIN:MAX",
+                   help="elastic scale-in/out (reference ElasticManager "
+                        "manager.py:125): on worker failure, relaunch at "
+                        "the SURVIVING world size (>= MIN) with rewritten "
+                        "ranks/endpoints instead of the original np; "
+                        "workers resume from their distributed "
+                        "checkpoint at the new world size")
     p.add_argument("script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p.parse_args()
 
 
-def _spawn(args, attempt):
-    nprocs = args.nproc_per_node
+def _spawn(args, attempt, nprocs=None):
+    nprocs = nprocs if nprocs is not None else args.nproc_per_node
     world = args.nnodes * nprocs
     master = args.master or "127.0.0.1:8476"
     log_dir = args.log_dir
@@ -80,6 +87,15 @@ def _spawn(args, attempt):
 
 def main():
     args = _parse()
+    if args.np_range:
+        try:
+            lo, hi = (int(v) for v in args.np_range.split(":"))
+        except ValueError:
+            raise SystemExit(
+                f"--np_range must be MIN:MAX, got {args.np_range!r}")
+        if not (1 <= lo <= hi):
+            raise SystemExit(
+                f"--np_range needs 1 <= MIN <= MAX, got {args.np_range!r}")
     if args.master is None and args.nnodes == 1:
         # single-host default: an OS-assigned ephemeral port, so
         # concurrent jobs on one machine (e.g. parallel test runs)
@@ -88,6 +104,7 @@ def main():
         # coordinator binding it.
         args.master = f"127.0.0.1:{_free_port()}"
     attempt = 0
+    cur_np = args.nproc_per_node
     procs = _spawn(args, attempt)
     code = 0
 
@@ -117,13 +134,26 @@ def main():
                     break
             if failed is not None:
                 rank, ret = failed
+                # surviving workers BEFORE teardown (scale-in basis)
+                n_alive = sum(1 for _, p in procs if p.poll() is None)
                 _kill_all()
                 if attempt < args.max_restarts:
                     attempt += 1
+                    next_np = cur_np
+                    if args.np_range:
+                        lo, hi = (int(v) for v in
+                                  args.np_range.split(":"))
+                        # ElasticManager scale-in: continue at the
+                        # surviving count, clamped to [lo, hi]
+                        next_np = max(lo, min(hi, max(n_alive, lo)))
+                        if next_np != cur_np:
+                            print(f"[launch] scaling {cur_np} -> "
+                                  f"{next_np} workers", file=sys.stderr)
                     print(f"[launch] worker {rank} exited with {ret}; "
                           f"relaunching job (attempt {attempt}/"
                           f"{args.max_restarts})", file=sys.stderr)
-                    procs = _spawn(args, attempt)
+                    cur_np = next_np
+                    procs = _spawn(args, attempt, nprocs=cur_np)
                     continue
                 print(f"[launch] worker {rank} exited with {ret}; "
                       "terminating job", file=sys.stderr)

@@ -22,17 +22,101 @@ from paddle_tpu.core.flags import get_flag
 
 
 class CollectiveWatchdog:
+    """Device-progress watchdog with cross-rank attribution.
+
+    When `store` (or FLAGS_watchdog_store_root) is set, every rank
+    publishes its progress — wall time of the last successful probe and
+    an op counter from the dispatch layer — under
+    ``watchdog/{job}/{rank}``. On a local timeout the dump reads every
+    rank's published progress and names the straggler(s): ranks whose
+    last heartbeat is older than the timeout (or missing entirely) —
+    the role of the reference's comm_task_manager per-collective
+    start/end records (comm_task_manager.cc), re-based on progress
+    heartbeats because XLA collectives cannot be individually
+    instrumented from Python."""
+
     def __init__(self, timeout_s: Optional[float] = None,
-                 interval_s: float = 10.0,
-                 on_timeout: Optional[Callable] = None):
+                 interval_s: Optional[float] = None,
+                 on_timeout: Optional[Callable] = None,
+                 store=None, job_id: str = "default",
+                 rank: Optional[int] = None,
+                 world_size: Optional[int] = None):
         self.timeout_s = timeout_s if timeout_s is not None else \
             get_flag("FLAGS_collective_timeout_s")
-        self.interval_s = interval_s
+        self.interval_s = interval_s if interval_s is not None else \
+            get_flag("FLAGS_watchdog_interval_s")
         self.on_timeout = on_timeout
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.last_ok = time.monotonic()
         self.tripped = False
+        self.stragglers: Optional[list] = None
+        self.job_id = job_id
+        if rank is None:
+            try:
+                rank = jax.process_index()
+            except Exception:
+                rank = 0
+        self.rank = rank
+        if world_size is None:
+            try:
+                world_size = jax.process_count()
+            except Exception:
+                world_size = None
+        self.world_size = world_size
+        if store is None:
+            root = get_flag("FLAGS_watchdog_store_root")
+            if root:
+                from .elastic import FileKVStore
+                store = FileKVStore(root)
+        self.store = store
+        self._op_count = 0
+        self._unobserve = None
+        if self.store is not None:
+            from paddle_tpu.core import dispatch as _dispatch
+
+            def _count(name, outs):
+                self._op_count += 1
+            self._unobserve = _dispatch.add_op_observer(_count)
+
+    def _publish(self):
+        if self.store is None:
+            return
+        import json
+        self.store.put(
+            f"watchdog/{self.job_id}/{self.rank}",
+            json.dumps({"ts": time.time(), "ops": self._op_count}))
+
+    def _read_peers(self):
+        if self.store is None:
+            return {}
+        import json
+        out = {}
+        for k, v in self.store.get_prefix(
+                f"watchdog/{self.job_id}/").items():
+            try:
+                out[int(k.rsplit("/", 1)[-1])] = json.loads(v)
+            except (ValueError, TypeError):
+                pass
+        return out
+
+    def find_stragglers(self):
+        """Ranks whose last published heartbeat is older than the
+        timeout relative to the freshest rank, PLUS ranks that never
+        published at all (expected via world_size — a peer that died
+        before its first heartbeat must still be named)."""
+        peers = self._read_peers()
+        if not peers:
+            return None
+        newest = max(p["ts"] for p in peers.values())
+        stale = [r for r, p in peers.items()
+                 if newest - p["ts"] > min(self.timeout_s,
+                                           2 * self.interval_s + 1.0)]
+        missing = []
+        if self.world_size:
+            missing = [r for r in range(self.world_size)
+                       if r not in peers]
+        return sorted(set(stale) | set(missing))
 
     def _probe_once(self) -> bool:
         done = threading.Event()
@@ -51,9 +135,11 @@ class CollectiveWatchdog:
 
     def _loop(self):
         try:
+            self._publish()
             while not self._stop.wait(self.interval_s):
                 if self._probe_once():
                     self.last_ok = time.monotonic()
+                    self._publish()
                 else:
                     self.tripped = True
                     self._dump()
@@ -74,6 +160,29 @@ class CollectiveWatchdog:
                   "device_count:", len(jax.devices()))
         except Exception:
             pass
+        self.stragglers = self.find_stragglers()
+        if self.stragglers is not None:
+            peers = self._read_peers()
+            print("per-rank progress (published heartbeats):")
+            now = time.time()
+            for r in sorted(peers):
+                p = peers[r]
+                tag = "  <-- STRAGGLER" if r in self.stragglers else ""
+                print(f"  rank {r}: ops={p.get('ops')} "
+                      f"last_heartbeat={now - p['ts']:.1f}s ago{tag}")
+            if self.stragglers:
+                print(f"suspected straggler rank(s): {self.stragglers}")
+            else:
+                print("all ranks show fresh heartbeats — suspect the "
+                      "local device/runtime, not a peer")
+        dump_path = get_flag("FLAGS_memory_stats_dump_path")
+        if dump_path:
+            try:
+                from paddle_tpu import device as _device
+                _device.dump_memory_stats(dump_path)
+                print(f"memory stats dumped to {dump_path}")
+            except Exception:
+                pass
         print("live python threads:")
         for tid, frame in sys_frames():
             print(f"  thread {tid}:")
@@ -92,6 +201,9 @@ class CollectiveWatchdog:
         if self._thread is not None:
             self._thread.join(timeout=1.0)
             self._thread = None
+        if self._unobserve is not None:
+            self._unobserve()
+            self._unobserve = None
 
 
 def sys_frames():

@@ -240,9 +240,9 @@ from paddle_tpu.core import tensor as _tensor_mod  # noqa: E402
 
 _tensor_mod.set_scalarize_interceptor(_scalarize_interceptor)
 
-#: cap on cached specializations per input signature; beyond it the
-#: signature falls back to eager (decision traces that differ on every
-#: call would otherwise retrace forever)
+#: default cap on cached specializations per input signature; the LIVE
+#: value is FLAGS_max_specializations (this constant is its default and
+#: is kept for back-compat readers)
 MAX_SPECIALIZATIONS = 8
 
 #: weak registry of StaticFunctions for the module-level report API
@@ -453,11 +453,13 @@ class StaticFunction:
                 "signature stays eager", stacklevel=3)
             entry["fallback"] = "volatile float guard"
             return result
-        if len(entry["specs"]) >= MAX_SPECIALIZATIONS:
+        from paddle_tpu.core.flags import get_flag as _gf
+        if len(entry["specs"]) >= _gf("FLAGS_max_specializations"):
             import warnings
             warnings.warn(
                 f"to_static: {self._fn.__qualname__} exceeded "
-                f"{MAX_SPECIALIZATIONS} specializations for one input "
+                f"{_gf('FLAGS_max_specializations')} specializations "
+                f"for one input "
                 "signature (value-dependent control flow thrashes); "
                 "falling back to eager execution", stacklevel=3)
             entry["fallback"] = "specialization limit exceeded"
@@ -510,6 +512,19 @@ class StaticFunction:
                     t.grad = g
                 gen._key, gen._offset = saved_key, saved_off
 
+        from paddle_tpu.core.flags import get_flag as _gf
+        if _gf("FLAGS_print_jaxpr"):
+            import sys as _sys
+
+            def _printing(state_arrays, rng_key, arg_arrays,
+                          _inner=pure):
+                print(jax.make_jaxpr(_inner)(state_arrays, rng_key,
+                                             arg_arrays),
+                      file=_sys.stderr)
+                return _inner(state_arrays, rng_key, arg_arrays)
+            spec.jitted = jax.jit(_printing,
+                                  donate_argnums=(0,) if donate else ())
+            return spec
         spec.jitted = jax.jit(pure, donate_argnums=(0,) if donate else ())
         return spec
 

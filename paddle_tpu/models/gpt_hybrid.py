@@ -51,6 +51,11 @@ class ParallelConfig:
     # with O(pp) liveness (parallel/pipeline_1f1b.py — the compiled
     # analog of the reference 1F1B, pipeline_parallel.py:547)
     pp_schedule: str = "gpipe"
+    # virtual pipeline chunks per device (interleaved VPP,
+    # PipelineParallelWithInterleave pipeline_parallel.py:1143): the
+    # stage's layers split into v chunks; backward recomputation spans
+    # L/(pp*v) layers instead of L/pp. Requires pp>1 + pp_schedule 1f1b
+    vpp_chunks: int = 1
     remat: bool = True
     # remat granularity: "full" recomputes the whole block (min memory);
     # "dots" saves matmul/einsum outputs and recomputes only elementwise
@@ -169,18 +174,37 @@ def param_specs(cfg: GPTConfig, pcfg: ParallelConfig) -> Dict:
 def shard_params(params, mesh, cfg, pcfg):
     specs = param_specs(cfg, pcfg)
     if pcfg.pp > 1:
-        # blocks leaves [L, ...] -> [pp, L/pp, ...]; stage dim carries 'pp',
-        # the per-layer dim is unsharded, trailing dims keep their tp/ep spec
+        # blocks leaves [L, ...] -> [pp, L/pp, ...] (vpp>1:
+        # [pp, v, L/(pp*v), ...] — virtual stage sigma = j*pp + s lives
+        # at [s, j]); stage dim carries 'pp', chunk/per-layer dims are
+        # unsharded, trailing dims keep their tp/ep spec
         L = cfg.num_layers
+        v = pcfg.vpp_chunks
         params = dict(params)
-        params["blocks"] = jax.tree_util.tree_map(
-            lambda x: x.reshape((pcfg.pp, L // pcfg.pp) + x.shape[1:]),
-            params["blocks"])
+        if v > 1:
+            if L % (pcfg.pp * v):
+                raise ValueError(
+                    f"num_layers {L} not divisible by pp*vpp_chunks "
+                    f"{pcfg.pp}*{v}")
+            # virtual stage sigma = j*pp + s owns layers
+            # [sigma*Lc, (sigma+1)*Lc): reorder [pp*v, Lc] -> [pp, v, Lc]
+            Lc = L // (pcfg.pp * v)
+            params["blocks"] = jax.tree_util.tree_map(
+                lambda x: x.reshape((v, pcfg.pp, Lc) + x.shape[1:])
+                .swapaxes(0, 1),
+                params["blocks"])
+            extra = (None,)
+        else:
+            params["blocks"] = jax.tree_util.tree_map(
+                lambda x: x.reshape((pcfg.pp, L // pcfg.pp)
+                                    + x.shape[1:]),
+                params["blocks"])
+            extra = ()
         flat_specs = param_specs(
             cfg, ParallelConfig(**{**pcfg.__dict__, "pp": 1}))["blocks"]
         specs = dict(specs)
         specs["blocks"] = jax.tree_util.tree_map(
-            lambda s: P("pp", None, *tuple(s)[1:]), flat_specs)
+            lambda s: P("pp", *extra, None, *tuple(s)[1:]), flat_specs)
     return jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
         params, specs), specs
@@ -344,6 +368,11 @@ def forward_hidden(params, input_ids, cfg: GPTConfig,
                                     params["blocks"])
 
     if pcfg.pp > 1:
+        if pcfg.vpp_chunks > 1:
+            raise NotImplementedError(
+                "forward_hidden (eval/inference) does not run the "
+                "interleaved-VPP layout; evaluate with vpp_chunks=1 "
+                "(same weights reshaped) or through the training step")
         from paddle_tpu.parallel.pipeline import (pipeline_apply,
                                                   pipeline_microbatch)
         mb = pipeline_microbatch(x, pcfg.microbatches)
@@ -541,6 +570,12 @@ def _train_grads_1f1b(params, batch, cfg, pcfg, mesh):
                 head_loss, argnums=(0, 1))(hp, y)
             return l, gy, ghp
 
+        if pcfg.vpp_chunks > 1:
+            from paddle_tpu.parallel.pipeline_1f1b import \
+                pipeline_train_interleaved
+            return pipeline_train_interleaved(
+                stage_fn, blocks, mb, last_grad,
+                head_params=head_params, num_chunks=pcfg.vpp_chunks)
         return pipeline_train_1f1b(stage_fn, blocks, mb, last_grad,
                                    head_params=head_params)
 
@@ -568,6 +603,11 @@ def build_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
         raise ValueError(
             f"pp_schedule must be 'gpipe' or '1f1b', got "
             f"{pcfg.pp_schedule!r}")
+    if pcfg.vpp_chunks > 1 and (pcfg.pp <= 1
+                                or pcfg.pp_schedule != "1f1b"):
+        raise ValueError(
+            "vpp_chunks > 1 requires pp > 1 with pp_schedule='1f1b' "
+            "(the interleaved schedule generalizes the compiled 1F1B)")
     if pcfg.pp > 1 and pcfg.pp_schedule == "1f1b":
         def grads_of(params, batch):
             return _train_grads_1f1b(params, batch, cfg, pcfg, mesh)

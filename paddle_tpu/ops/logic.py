@@ -9,11 +9,11 @@ from paddle_tpu.core.tensor import Tensor
 from .math import _promote_binary
 
 
-def _cmp(name, f):
+def _cmp(op_name, f):
     def op(x, y, name=None):
         x, y = _promote_binary(x, y)
-        return run_op(name, f, x, y, differentiable=False)
-    op.__name__ = name
+        return run_op(op_name, f, x, y, differentiable=False)
+    op.__name__ = op_name
     return op
 
 

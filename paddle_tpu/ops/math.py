@@ -48,7 +48,10 @@ def _promote_binary(x, y):
     return x, y
 
 
-def _binop(name, f):
+def _binop(op_name, f):
+    # NB: the user-facing `name=None` kwarg must not shadow the op name
+    # (it used to — every binop dispatched as op 'None', invisible to
+    # AMP lists, op observers and NaN/Inf messages)
     def op(x, y, name=None):
         from paddle_tpu.ops.manipulation import cast
         if isinstance(x, Tensor) and isinstance(y, Tensor) \
@@ -58,8 +61,8 @@ def _binop(name, f):
             y = cast(y, d) if y.dtype != d else y
         else:
             x, y = _promote_binary(x, y)
-        return run_op(name, f, x, y)
-    op.__name__ = name
+        return run_op(op_name, f, x, y)
+    op.__name__ = op_name
     return op
 
 
@@ -103,15 +106,15 @@ def _unary(name, f):
     return op
 
 
-def _float_unary(name, f):
+def _float_unary(op_name, f):
     """Unary op that promotes int inputs to the default float dtype (paddle
     activation-op semantics)."""
     def op(x, name=None):
         if isinstance(x, Tensor) and dtype_mod.is_integer(x.dtype):
             x = Tensor._wrap(
                 x._data.astype(dtype_mod.get_default_dtype()))
-        return run_op(name, f, x)
-    op.__name__ = name
+        return run_op(op_name, f, x)
+    op.__name__ = op_name
     return op
 
 

@@ -213,6 +213,9 @@ def decide(q, k, causal) -> Optional[str]:
     """
     if not get_flag("FLAGS_attn_autotune"):
         return None
+    if get_flag("FLAGS_deterministic"):
+        # deterministic mode: no measurement-dependent kernel choice
+        return None
     bshd = tuple(q.shape)
     skv = k.shape[1]
     hit = lookup(bshd, skv, q.dtype, causal)

@@ -177,3 +177,188 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params, x_microbatches,
                    axis_name)
     grads = jax.tree_util.tree_map(lambda g: g[None], grads)
     return loss, grads, head, dx0
+
+
+# ---------------------------------------------------------------------
+# Compiled interleaved virtual-pipeline (VPP) — round 3
+# ---------------------------------------------------------------------
+
+def compiled_interleaved_schedule(n_stages: int, n_microbatches: int,
+                                  n_chunks: int) -> Schedule:
+    """The lockstep timeline `pipeline_train_interleaved` compiles, as a
+    checkable pp_schedule.Schedule (reference analog:
+    PipelineParallelWithInterleave, pipeline_parallel.py:1143 /
+    pipeline_vpp.py).
+
+    Virtual stage of (chunk j, device s) is sigma = j*n + s: consecutive
+    virtual stages sit on consecutive ring devices, with the chunk
+    boundary riding the ring's (n-1 -> 0) wrap — so ONE collective
+    permute per tick serves both intra- and inter-chunk activation
+    transfer. At tick t, virtual stage sigma forwards microbatch t -
+    sigma and backwards t - 2(Ng-1) + sigma (Ng = n*v virtual stages).
+    """
+    n, m, v = n_stages, n_microbatches, n_chunks
+    ng = n * v
+    per_stage = []
+    for s in range(n):
+        ops = []
+        for t in range(m + 2 * (ng - 1)):
+            for j in range(v):
+                sigma = j * n + s
+                mf = t - sigma
+                if 0 <= mf < m:
+                    ops.append(PipeOp("F", s, mf, j))
+                mb = t - 2 * (ng - 1) + sigma
+                if 0 <= mb < m:
+                    ops.append(PipeOp("B", s, mb, j))
+        per_stage.append(ops)
+    return Schedule(f"compiled-VPP{v}", n, m, per_stage, n_chunks=v)
+
+
+def pipeline_train_interleaved(stage_fn: Callable, stage_params,
+                               x_microbatches,
+                               last_stage_grad: Callable,
+                               head_params=None,
+                               axis_name: str = "pp",
+                               num_chunks: int = 2,
+                               grad_dtype=jnp.float32):
+    """Interleaved VPP inside shard_map: each device runs `num_chunks`
+    virtual-stage "lanes"; lane j on device s is virtual stage j*n + s
+    of an (n*v)-deep pipeline. Consecutive virtual stages sit on ring
+    neighbors, so ONE ppermute per tick serves both intra- and
+    inter-chunk hops (the chunk boundary rides the n-1 -> 0 wrap).
+
+    Same contract as pipeline_train_1f1b except stage_params leaves
+    carry per-device leading dims [1, v, ...] (stage dim sharded over
+    `axis_name`, chunk dim local); returned grads match that layout.
+
+    Memory design: the per-tick lane work runs as INNER lax.scans
+    (forward lanes ascending, then the head once, then backward lanes),
+    so only ONE lane's vjp residuals are live at a time — the
+    rematerialization window shrinks from L/pp layers (1F1B) to
+    L/(pp*v), which is VPP's activation-memory lever. The stash grows
+    to v rings of 2(nv-1)+1 microbatch inputs (cheap next to
+    residuals at transformer scale).
+    """
+    n = lax.axis_size(axis_name)
+    s = lax.axis_index(axis_name)
+    v = num_chunks
+    ng = n * v
+    m = x_microbatches.shape[0]
+    t_total = m + 2 * (ng - 1)
+    k = 2 * (ng - 1) + 1
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+    bwd_perm = [((i + 1) % n, i) for i in range(n)]
+
+    # [v, ...] per-device chunk-stacked params
+    lane_params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+
+    def _varying(x):
+        return lax.pcast(x, (axis_name,), to="varying")
+
+    head_params_v = (None if head_params is None else
+                     jax.tree_util.tree_map(_varying, head_params))
+
+    x_shape = x_microbatches.shape[1:]
+    dtype = x_microbatches.dtype
+    acts0 = _varying(jnp.zeros((v,) + x_shape, dtype))
+    cots0 = _varying(jnp.zeros((v,) + x_shape, dtype))
+    stash0 = _varying(jnp.zeros((v, k) + x_shape, dtype))
+    grads0 = jax.tree_util.tree_map(
+        lambda p: _varying(jnp.zeros(p.shape, grad_dtype)), lane_params)
+    _, _, probe_hg = last_stage_grad(jnp.zeros(x_shape, dtype),
+                                     head_params_v,
+                                     jnp.zeros((), jnp.int32))
+    head0 = None if probe_hg is None else jax.tree_util.tree_map(
+        lambda g: _varying(jnp.zeros(g.shape, grad_dtype)), probe_hg)
+    dx0_buf0 = _varying(jnp.zeros((m,) + x_shape, dtype))
+    lane_idx = jnp.arange(v, dtype=jnp.int32)
+
+    def tick(carry, t):
+        acts_in, cots_in, stash, grads, head, loss, dx0_buf = carry
+        sigma = lane_idx * n + s                       # [v]
+        mf = t - sigma
+        # lane j's forward input: lane j-1's (permuted) output at the
+        # chunk boundary (s==0), else lane j's own ring input; lane 0
+        # at s==0 reads the microbatch stream
+        src0 = jnp.concatenate(
+            [x_microbatches[jnp.clip(t - s, 0, m - 1)][None],
+             acts_in[:-1]], axis=0)
+        act_sel = jnp.where(s == 0, src0, acts_in)
+
+        # vectorized stash write (outside the lane scans so the big
+        # [v, k, ...] buffer is never copied through scan outputs)
+        stash = lax.dynamic_update_slice_in_dim(
+            stash, act_sel[:, None], jnp.mod(t, k), 1)
+
+        def fwd_body(_, xs):
+            act_j, params_j = xs
+            return None, stage_fn(params_j, act_j)
+
+        _, ys = lax.scan(fwd_body, None, (act_sel, lane_params))
+
+        # head/loss: the LAST virtual stage is lane v-1 on device n-1;
+        # paid once per tick (as in 1F1B)
+        mf_last = t - ((v - 1) * n + s)
+        f_active_last = (mf_last >= 0) & (mf_last < m)
+        loss_mb, dy_seed, hgrads = last_stage_grad(
+            ys[v - 1], head_params_v, jnp.clip(mf_last, 0, m - 1))
+        is_last = s == n - 1
+        if head is not None:
+            hmask = is_last & f_active_last
+            head = jax.tree_util.tree_map(
+                lambda g, d: g + jnp.where(hmask, d.astype(g.dtype), 0),
+                head, hgrads)
+        loss = loss + jnp.where(is_last & f_active_last, loss_mb, 0.0)
+
+        # lane j's cotangent: lane j+1's (permuted) dx at the chunk
+        # boundary (s==n-1), else lane j's own ring input; lane v-1 at
+        # s==n-1 seeds from the head
+        cot_next = jnp.concatenate(
+            [cots_in[1:], dy_seed.astype(dtype)[None]], axis=0)
+        cot_sel = jnp.where(s == n - 1, cot_next, cots_in)
+        mb = t - 2 * (ng - 1) + sigma                  # [v]
+        b_active = (mb >= 0) & (mb < m)
+
+        def bwd_body(_, xs):
+            jidx, cot_j, stash_j, params_j, grads_j = xs
+            sig = jidx * n + s
+            x_b = stash_j[jnp.mod(t - 2 * (ng - 1 - sig), k)]
+            _, vjp = jax.vjp(stage_fn, params_j, x_b)
+            dp, dx = vjp(cot_j.astype(x_b.dtype))
+            ba = (t - 2 * (ng - 1) + sig >= 0) & \
+                (t - 2 * (ng - 1) + sig < m)
+            grads_j = jax.tree_util.tree_map(
+                lambda g, d: g + jnp.where(ba, d.astype(g.dtype), 0),
+                grads_j, dp)
+            return None, (dx, grads_j)
+
+        _, (dxs, grads) = lax.scan(
+            bwd_body, None,
+            (lane_idx, cot_sel, stash, lane_params, grads))
+
+        dx0_buf = lax.cond(
+            (s == 0) & b_active[0],
+            lambda buf: lax.dynamic_update_index_in_dim(
+                buf, dxs[0].astype(dtype), jnp.clip(mb[0], 0, m - 1), 0),
+            lambda buf: buf, dx0_buf)
+
+        acts_out = lax.ppermute(ys, axis_name, fwd_perm)
+        cots_out = lax.ppermute(dxs.astype(dtype), axis_name, bwd_perm)
+        return (acts_out, cots_out, stash, grads, head, loss,
+                dx0_buf), None
+
+    carry0 = (acts0, cots0, stash0, grads0, head0,
+              _varying(jnp.zeros((), grad_dtype)), dx0_buf0)
+    carry, _ = lax.scan(tick, carry0, jnp.arange(t_total))
+    _, _, _, grads, head, loss, dx0_buf = carry
+    loss = lax.psum(jnp.where(s == n - 1, loss, 0.0), axis_name)
+    if head is not None:
+        head = jax.tree_util.tree_map(
+            lambda g: lax.psum(jnp.where(s == n - 1, g,
+                                         jnp.zeros_like(g)),
+                               axis_name), head)
+    dx0 = lax.psum(jnp.where(s == 0, dx0_buf, jnp.zeros_like(dx0_buf)),
+                   axis_name)
+    grads = jax.tree_util.tree_map(lambda g: g[None], grads)
+    return loss, grads, head, dx0

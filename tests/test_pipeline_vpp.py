@@ -1,0 +1,189 @@
+"""Compiled interleaved VPP (parallel/pipeline_1f1b.py round-3 addition)
++ ZBVPP descriptor (VERDICT r2 item 5).
+
+  1. numerics — loss + all grads of the v*n-deep virtual pipeline match
+     plain autodiff of the sequential composition;
+  2. schedule equivalence — the compiled timeline validates under the
+     dependency simulator;
+  3. ZBVPP descriptor validates with bubble <= fused-backward 1F1B in
+     the small-microbatch regime it targets;
+  4. the hybrid engine runs vpp_chunks=2 with loss parity vs pp=2 1F1B.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from paddle_tpu.parallel.pipeline_1f1b import (
+    compiled_interleaved_schedule, pipeline_train_interleaved)
+from paddle_tpu.parallel.pp_schedule import (schedule_1f1b,
+                                             schedule_zbvpp)
+
+N_DEV = 2
+V = 2
+HID = 8
+
+
+def _stage_fn(params, x):
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + x
+
+
+def _head(y, wh, t):
+    return jnp.mean((y @ wh - t) ** 2)
+
+
+def _make(m, seed=0):
+    rng = np.random.RandomState(seed)
+    # n*v virtual stages; laid out [n_dev, v, ...] (device, chunk)
+    def mk():
+        return {"w1": jnp.asarray(rng.randn(HID, HID) * 0.3, jnp.float32),
+                "b1": jnp.asarray(rng.randn(HID) * 0.1, jnp.float32),
+                "w2": jnp.asarray(rng.randn(HID, HID) * 0.3, jnp.float32)}
+    virt = [mk() for _ in range(N_DEV * V)]
+    wh = jnp.asarray(rng.randn(HID, 3) * 0.4, jnp.float32)
+    mb = jnp.asarray(rng.randn(m, 2, HID), jnp.float32)
+    tgt = jnp.asarray(rng.randn(m, 2, 3), jnp.float32)
+    return virt, wh, mb, tgt
+
+
+def _stack_virtual(virt):
+    """virtual stage sigma = j*n + s -> stacked leaf [n, v, ...]."""
+    out = {}
+    for key in virt[0]:
+        rows = []
+        for s in range(N_DEV):
+            rows.append(jnp.stack([virt[j * N_DEV + s][key]
+                                   for j in range(V)]))
+        out[key] = jnp.stack(rows)          # [n, v, ...]
+    return out
+
+
+def _oracle(virt, wh, mb, tgt):
+    def total(virt, wh):
+        def per(x, t):
+            for p in virt:
+                x = _stage_fn(p, x)
+            return _head(x, wh, t)
+        return sum(per(mb[i], tgt[i]) for i in range(mb.shape[0]))
+    loss, (gv, gwh) = jax.value_and_grad(total, argnums=(0, 1))(virt, wh)
+
+    def loss_of_x(x0):
+        def per(x, t):
+            for p in virt:
+                x = _stage_fn(p, x)
+            return _head(x, wh, t)
+        return sum(per(x0[i], tgt[i]) for i in range(mb.shape[0]))
+    dx0 = jax.grad(loss_of_x)(mb)
+    return loss, gv, gwh, dx0
+
+
+@pytest.mark.parametrize("m", [4, 8])
+def test_vpp_matches_autodiff_oracle(m):
+    virt, wh, mb, tgt = _make(m)
+    mesh = Mesh(np.asarray(jax.devices()[:N_DEV]), ("pp",))
+    stacked = _stack_virtual(virt)
+
+    def body(stacked, mb, tgt, wh):
+        def last_grad(y, hp, mb_idx):
+            t = tgt[mb_idx]
+
+            def head_loss(wh_, y_):
+                return _head(y_, wh_, t)
+            (loss, (gwh, gy)) = jax.value_and_grad(
+                head_loss, argnums=(0, 1))(hp["wh"], y)
+            return loss, gy, {"wh": gwh}
+        return pipeline_train_interleaved(
+            _stage_fn, stacked, mb, last_grad, head_params={"wh": wh},
+            num_chunks=V)
+
+    specs = jax.tree_util.tree_map(lambda _: P("pp"), stacked)
+    loss, grads, head, dx0 = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(specs, P(None), P(None), P(None)),
+        out_specs=(P(), specs, P(), P(None))))(stacked, mb, tgt, wh)
+
+    ref_loss, ref_gv, ref_wh, ref_dx0 = _oracle(virt, wh, mb, tgt)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(head["wh"]),
+                               np.asarray(ref_wh), rtol=1e-4, atol=1e-5)
+    for s in range(N_DEV):
+        for j in range(V):
+            ref = ref_gv[j * N_DEV + s]
+            for name in ("w1", "b1", "w2"):
+                np.testing.assert_allclose(
+                    np.asarray(grads[name][s, j]), np.asarray(ref[name]),
+                    rtol=1e-4, atol=1e-5,
+                    err_msg=f"dev{s}.chunk{j}.{name}")
+    np.testing.assert_allclose(np.asarray(dx0), np.asarray(ref_dx0),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_compiled_vpp_schedule_validates():
+    for n, m, v in [(2, 4, 2), (4, 8, 2), (2, 8, 3)]:
+        sched = compiled_interleaved_schedule(n, m, v)
+        makespan, bubble = sched.simulate()   # raises if invalid
+        assert makespan > 0
+        # every virtual stage's F and B present for every microbatch
+        cells = {(o.kind, o.stage, o.mb, o.chunk)
+                 for ops in sched.per_stage for o in ops}
+        assert len(cells) == 2 * n * m * v
+
+
+def test_zbvpp_descriptor_validates_and_beats_1f1b_bubble():
+    # the small-M regime is where pipeline bubbles matter (M >> n makes
+    # any schedule's bubble vanish); ZB targets exactly this regime
+    for n, m in [(2, 4), (4, 8), (4, 16), (8, 16)]:
+        z = schedule_zbvpp(n, m)
+        _, bub = z.simulate()
+        _, bub1 = schedule_1f1b(n, m).simulate()
+        assert bub <= bub1 + 1e-9, (n, m, bub, bub1)
+        # B/W split exists
+        kinds = {o.kind for ops in z.per_stage for o in ops}
+        assert kinds == {"F", "B", "W"}
+
+
+def test_hybrid_engine_vpp_matches_1f1b():
+    """ParallelConfig.vpp_chunks=2 on pp=2: same loss and params as the
+    plain 1F1B schedule (8-dev CPU mesh, 2 pipeline devices)."""
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.models.gpt_hybrid import ParallelConfig, setup
+
+    cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=4,
+                    num_heads=2, max_seq_len=16)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, 64, (4, 16)))
+
+    results = {}
+    for tag, kw in [("1f1b", dict(pp_schedule="1f1b")),
+                    ("vpp", dict(pp_schedule="1f1b", vpp_chunks=2))]:
+        pcfg = ParallelConfig(dp=1, pp=2, tp=1, microbatches=4,
+                              remat=True, fused_ce=False,
+                              param_dtype=jnp.float32,
+                              compute_dtype=jnp.float32, **kw)
+        mesh, params, opt, step = setup(cfg, pcfg, seed=0,
+                                        devices=jax.devices()[:2])
+        with mesh:
+            new_params, _, loss = step(params, opt, (ids, ids))
+        results[tag] = (float(loss), new_params)
+
+    l1, p1 = results["1f1b"]
+    l2, p2 = results["vpp"]
+    np.testing.assert_allclose(l2, l1, rtol=1e-5)
+    # storage orders differ: 1f1b blocks are [pp, L/pp, ...] (layer =
+    # s*L/pp + i); vpp blocks are [pp, v, Lc, ...] with virtual stage
+    # j*pp + s owning layers [(j*pp+s)*Lc, ...) — compare per LAYER
+    for key in p1["blocks"]:
+        a = np.asarray(p1["blocks"][key])
+        L = a.shape[0] * a.shape[1]
+        a = a.reshape((L,) + a.shape[2:])
+        b = np.asarray(p2["blocks"][key])
+        b = b.swapaxes(0, 1).reshape((L,) + b.shape[3:])
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5,
+                                   err_msg=key)
+    for key in ("wte", "wpe", "lnf_g", "lnf_b"):
+        np.testing.assert_allclose(np.asarray(p1[key]),
+                                   np.asarray(p2[key]), rtol=2e-4,
+                                   atol=2e-5, err_msg=key)

@@ -228,3 +228,86 @@ def test_unique_name_and_run_check():
         c = unique_name.generate("fc")
         assert c == "fc_0"
     assert run_check()
+
+
+def test_dist_checkpoint_load_is_shard_wise(tmp_path):
+    """VERDICT r2 item 6: loading a sharded tensor must not materialize
+    the global array on host — peak host allocation stays O(shard)."""
+    import tracemalloc
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P, Mesh
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.distributed import checkpoint as dc
+
+    n = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    sh = NamedSharding(mesh, P("x", None))
+    global_shape = (1024 * n, 512)            # n=8: 16 MB fp32 global
+    global_bytes = int(np.prod(global_shape)) * 4
+    big = jax.device_put(
+        jax.numpy.zeros(global_shape, "float32") + 3.25, sh)
+    t = Tensor.__new__(Tensor)
+    t._init_from_array(big)
+    state = {"w": t}
+    dc.save_state_dict(state, str(tmp_path / "ckpt"))
+
+    target = Tensor.__new__(Tensor)
+    target._init_from_array(jax.device_put(
+        jax.numpy.zeros(global_shape, "float32"), sh))
+    state2 = {"w": target}
+    # spy on host staging: the largest single buffer the loader
+    # allocates must be shard-sized, never the global array (the old
+    # path's np.zeros(global_shape)). Total-peak is not meaningful on
+    # the CPU backend, where the target's device storage aliases host
+    # RAM by definition.
+    staged = []
+    orig_zeros = dc.np.zeros
+
+    def spy_zeros(shape, *a, **k):
+        arr = orig_zeros(shape, *a, **k)
+        staged.append(arr.nbytes)
+        return arr
+
+    tracemalloc.start()
+    dc.np.zeros = spy_zeros
+    try:
+        dc.load_state_dict(state2, str(tmp_path / "ckpt"))
+    finally:
+        dc.np.zeros = orig_zeros
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    np.testing.assert_allclose(np.asarray(target._data[:4, :4]), 3.25)
+    shard_bytes = global_bytes // n
+    assert staged and max(staged) <= shard_bytes, (staged, shard_bytes)
+    # and the traced transient peak stays bounded by the (aliased)
+    # device storage plus O(shard) staging — not 2x global
+    assert peak < global_bytes + 4 * shard_bytes, (peak, global_bytes)
+
+
+def test_dist_checkpoint_cross_mesh_block_reshard(tmp_path):
+    """Save sharded over 8, load sharded over a DIFFERENT axis layout:
+    per-shard assembly must stitch intersecting source entries."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P, Mesh
+    import numpy as np
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.distributed import checkpoint as dc
+
+    devs = jax.devices()
+    mesh8 = Mesh(np.array(devs), ("x",))
+    rng = np.random.default_rng(0)
+    val = rng.standard_normal((16, 12)).astype("float32")
+    src = Tensor.__new__(Tensor)
+    src._init_from_array(jax.device_put(
+        jax.numpy.asarray(val), NamedSharding(mesh8, P("x", None))))
+    dc.save_state_dict({"w": src}, str(tmp_path / "ck2"))
+
+    mesh24 = Mesh(np.array(devs).reshape(2, 4), ("a", "b"))
+    tgt = Tensor.__new__(Tensor)
+    tgt._init_from_array(jax.device_put(
+        jax.numpy.zeros((16, 12), "float32"),
+        NamedSharding(mesh24, P("b", "a"))))
+    dc.load_state_dict({"w": tgt}, str(tmp_path / "ck2"))
+    np.testing.assert_allclose(np.asarray(tgt._data), val)
