@@ -152,6 +152,8 @@ def _allocator_env(strategy: str) -> str:
 
 def _apply_matmul_precision(v):
     import jax
+    if get_flag("FLAGS_deterministic"):
+        return          # deterministic pin wins until it is disabled
     jax.config.update("jax_default_matmul_precision", v or None)
 
 

@@ -83,8 +83,11 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
         }
 
     def _write():
-        # atomic: a writer killed mid-save (elastic fault) must never
-        # leave a truncated npz/metadata pair for the resumed job
+        # atomic PER FILE: a writer killed mid-save never leaves a
+        # truncated npz/metadata. NOTE multi-host callers must still
+        # barrier across ranks around save (launch/coordination
+        # service): per-file atomicity cannot order rank 0's metadata
+        # publish against other ranks' shard writes
         shard = os.path.join(path, f"shard_{pid}.npz")
         np.savez(shard + ".tmp.npz", **arrays)
         os.replace(shard + ".tmp.npz", shard)

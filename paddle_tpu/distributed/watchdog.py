@@ -52,18 +52,10 @@ class CollectiveWatchdog:
         self.tripped = False
         self.stragglers: Optional[list] = None
         self.job_id = job_id
-        if rank is None:
-            try:
-                rank = jax.process_index()
-            except Exception:
-                rank = 0
-        self.rank = rank
-        if world_size is None:
-            try:
-                world_size = jax.process_count()
-            except Exception:
-                world_size = None
-        self.world_size = world_size
+        # rank/world resolved lazily: touching jax.process_index here
+        # would force backend init for the common store-less watchdog
+        self._rank = rank
+        self._world_size = world_size
         if store is None:
             root = get_flag("FLAGS_watchdog_store_root")
             if root:
@@ -78,6 +70,24 @@ class CollectiveWatchdog:
             def _count(name, outs):
                 self._op_count += 1
             self._unobserve = _dispatch.add_op_observer(_count)
+
+    @property
+    def rank(self):
+        if self._rank is None:
+            try:
+                self._rank = jax.process_index()
+            except Exception:
+                self._rank = 0
+        return self._rank
+
+    @property
+    def world_size(self):
+        if self._world_size is None:
+            try:
+                self._world_size = jax.process_count()
+            except Exception:
+                pass
+        return self._world_size
 
     def _publish(self):
         if self.store is None:

@@ -54,6 +54,9 @@ class Schedule:
         # -1: reversed (the ZB-V placement: device s holds virtual
         # stages s and 2n-1-s). Default: all forward (round-robin VPP).
         self.chunk_dirs = chunk_dirs or [1] * n_chunks
+        self._chain_list = self._build_chain()
+        self._chain_pos = {sc: i for i, sc in
+                           enumerate(self._chain_list)}
         # F=1; a fused backward (dgrad+wgrad) costs 2; split B and W cost
         # 1 each — the standard zero-bubble accounting.
         self.durations = durations or (
@@ -64,19 +67,21 @@ class Schedule:
         return any(op.kind == "W" for ops in self.per_stage for op in ops)
 
     # -- dependency model ---------------------------------------------
-    def _chain(self):
+    def _build_chain(self):
         """Virtual-stage order as (physical_stage, chunk) pairs,
-        honoring per-chunk traversal direction."""
+        honoring per-chunk traversal direction. Built once (chunk_dirs
+        is fixed at construction)."""
         order = []
         for c, d in enumerate(self.chunk_dirs):
-            rng = range(self.n_stages) if d > 0 else                 range(self.n_stages - 1, -1, -1)
+            rng = range(self.n_stages) if d > 0 else \
+                range(self.n_stages - 1, -1, -1)
             order += [(s_, c) for s_ in rng]
         return order
 
     def deps(self, op: PipeOp) -> List[PipeOp]:
         """Cross-stage + intra-cell dependencies of one cell."""
-        chain = self._chain()
-        pos = chain.index((op.stage, op.chunk))
+        chain = self._chain_list
+        pos = self._chain_pos[(op.stage, op.chunk)]
         out = []
         if op.kind == "F":
             if pos > 0:

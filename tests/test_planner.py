@@ -64,7 +64,13 @@ def test_7b_8chip_needs_model_parallelism():
 def test_7b_engine_capable_reproduces_tp4_pp2():
     """Constrained to the ZeRO stages the compiled engine executes
     (<=1), the planner's TOP-1 for 7B on 8 v5e chips is the BASELINE
-    hand config itself: TP4 x PP2 (+sp)."""
+    hand config itself: TP4 x PP2 (+sp). Since round 3 this plan family
+    is EXECUTABLE by the generic auto-parallel Engine on any model with
+    a homogeneous block chain (partitioner.py imposes tp via mp-axis
+    annotation and pp via the compiled 1F1B) — the bespoke hybrid
+    engine remains the tuned perf path, not the only capable one
+    (tests/test_auto_engine.py::test_engine_tp_pp_on_stock_llama_
+    loss_parity)."""
     p = Planner("v5e", zero_stages=(0, 1))
     best = p.plan(LLAMA7, 8, global_batch=32)[0]
     assert (best.tp, best.pp) == (4, 2), best.short()
