@@ -50,11 +50,19 @@ def main():
     # (HTTP 500, intermittent), so compile failures fall back to the
     # rolled loop instead of failing the bench. Partial unroll (4/8/12)
     # LOSES ~20% with fused CE — do not "compromise" on it.
-    def build(unroll):
+    def build(unroll, moment_dtype=jnp.float32, policy="names"):
         pcfg = ParallelConfig(dp=1, pp=1, tp=1, remat=True,
-                              remat_policy="names", scan_unroll=unroll,
+                              remat_policy=policy, scan_unroll=unroll,
                               param_dtype=jnp.bfloat16,
-                              compute_dtype=jnp.bfloat16)
+                              compute_dtype=jnp.bfloat16,
+                              moment_dtype=moment_dtype)
+        if policy == "names5":
+            pcfg = ParallelConfig(
+                dp=1, pp=1, tp=1, remat=True, remat_policy="names",
+                remat_save_names=("attn_out", "ffn1", "qkv", "proj",
+                                  "ffn2"),
+                scan_unroll=unroll, param_dtype=jnp.bfloat16,
+                compute_dtype=jnp.bfloat16, moment_dtype=moment_dtype)
         return setup(cfg, pcfg, seed=0, devices=jax.devices()[:1])
 
     rng = np.random.RandomState(0)
@@ -63,8 +71,9 @@ def main():
     # NOTE: sync via scalar readback (float(loss)), not block_until_ready —
     # the tunneled PJRT backend acks block_until_ready before the device
     # actually finishes; a host readback is the only true barrier there.
-    def timed(unroll):
-        mesh, params, opt_state, step = build(unroll)
+    def timed(unroll, moment_dtype=jnp.float32, policy="names"):
+        mesh, params, opt_state, step = build(unroll, moment_dtype,
+                                              policy)
         with mesh:
             for _ in range(warmup):
                 params, opt_state, loss = step(params, opt_state,
@@ -78,13 +87,49 @@ def main():
             dt = time.perf_counter() - t0
         return mesh, params, opt_state, step, dt
 
-    try:
-        mesh, params, opt_state, step, dt = timed(
-            cfg.num_layers if not on_cpu else 1)
-    except Exception as e:
-        print(f"full-unroll compile failed ({type(e).__name__}); "
-              "falling back to rolled scan", file=sys.stderr)
-        mesh, params, opt_state, step, dt = timed(1)
+    # Fallback ladder: the tunneled compile service intermittently (a)
+    # 500s on the huge full-unroll HLO and (b) switches to strict AOT
+    # hbm accounting under which the f32-moment program (19.2G est.)
+    # no longer fits — bf16 moments (~15G) do, with loss parity proven
+    # exact to 1e-6/30 steps (benchmarks/_r3_moment_parity.py).
+    # f32-moment rungs are fastest (1.04-1.05x measured) but need the
+    # tunnel's donation-preserving compile path (in+out 19G aliased);
+    # when the service is in its strict-AOT/no-donation regime only the
+    # bf16-moment configs (~15G un-aliased) run — measured 0.83-0.84x,
+    # loss parity exact to 1e-6 (benchmarks/_r3_moment_parity.py).
+    # Regime history in NOTES.md round-3.
+    attempts = [(cfg.num_layers, jnp.float32, "names"),
+                (1, jnp.float32, "names"),
+                (cfg.num_layers, jnp.bfloat16, "names5"),
+                (1, jnp.bfloat16, "names5"),
+                (1, jnp.bfloat16, "full")]
+    if on_cpu:
+        attempts = [(1, jnp.float32, "names")]
+    last = None
+    for unroll, md, policy in attempts:
+        if last is not None:
+            # free the previous rung's pinned buffers OUTSIDE the
+            # except block (active-exception state blocks collection)
+            import gc
+            gc.collect()
+            jax.clear_caches()
+        try:
+            mesh, params, opt_state, step, dt = timed(unroll, md,
+                                                      policy)
+            break
+        except Exception as e:
+            # drop the traceback: its frames pin the failed rung's
+            # device arrays (params+moments, ~13 GB) and would cascade
+            # OOM into every later rung
+            last = RuntimeError(
+                f"all bench configs failed; last: {type(e).__name__}: "
+                f"{e}")
+            del e
+            print(f"bench config (unroll={unroll}, moments="
+                  f"{md.__name__}, {policy}) failed; trying next",
+                  file=sys.stderr)
+    else:
+        raise last
 
     tokens_per_sec = batch * seq * steps / dt
 

@@ -1,0 +1,78 @@
+"""Round-3 perf ablation on the real chip: where does step time go?
+
+Measures the full 1.3B step, then variants with attention / LM-head+CE
+swapped for cheap stand-ins, giving wall-clock shares to target.
+"""
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.models import gpt_hybrid as GH
+
+    cfg = GPTConfig(vocab_size=50304, hidden_size=2048, num_layers=24,
+                    num_heads=16, max_seq_len=1024)
+    batch, seq, steps, warmup = 4, 1024, 6, 2
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+
+    def timed(tag, unroll=24):
+        pcfg = GH.ParallelConfig(dp=1, pp=1, tp=1, remat=True,
+                                 remat_policy="names",
+                                 scan_unroll=unroll,
+                                 param_dtype=jnp.bfloat16,
+                                 compute_dtype=jnp.bfloat16)
+        mesh, params, opt_state, step = GH.setup(
+            cfg, pcfg, seed=0, devices=jax.devices()[:1])
+        with mesh:
+            for _ in range(warmup):
+                params, opt_state, loss = step(params, opt_state,
+                                               (ids, ids))
+            float(loss)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                params, opt_state, loss = step(params, opt_state,
+                                               (ids, ids))
+            float(loss)
+            dt = (time.perf_counter() - t0) / steps
+        tok = batch * seq / dt
+        print(f"{tag}: {dt*1e3:.1f} ms/step  {tok:.0f} tok/s")
+        return dt
+
+    base = timed("full")
+
+    # ---- attention -> identity (shares stay comparable: same remat)
+    orig_attend = GH._attend
+
+    def no_attend(q, k, v, nh):
+        return v
+    GH._attend = no_attend
+    try:
+        no_attn = timed("no-attention")
+    finally:
+        GH._attend = orig_attend
+
+    # ---- LM head + CE -> cheap mean loss
+    orig_ce = GH._ce_from_hidden
+
+    def cheap_ce(x, wte, labels, pcfg):
+        return jnp.mean(x.astype(jnp.float32)) * 1e-6
+    GH._ce_from_hidden = cheap_ce
+    try:
+        no_head = timed("no-lmhead-ce")
+    finally:
+        GH._ce_from_hidden = orig_ce
+
+    print(f"attention share : {(base - no_attn) / base * 100:.1f}%")
+    print(f"lm-head+CE share: {(base - no_head) / base * 100:.1f}%")
+
+
+if __name__ == "__main__":
+    main()

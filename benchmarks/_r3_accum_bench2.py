@@ -1,0 +1,67 @@
+"""Two-program grad accumulation: memory-fitting variants (real chip)."""
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.models import gpt_hybrid as GH
+
+    cfg = GPTConfig(vocab_size=50304, hidden_size=2048, num_layers=24,
+                    num_heads=16, max_seq_len=1024)
+    seq = 1024
+    rng = np.random.RandomState(0)
+
+    import os
+    sel = os.environ.get("VARIANT", "")
+    variants = [
+        ("B4/full", 4, "full", 24),
+        ("B2/names", 2, "names", 24),
+        ("B2/full", 2, "full", 24),
+    ]
+    variants = [v for v in variants if not sel or v[0] == sel]
+    for tag, b, policy, unroll in variants:
+        ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (b, seq)))
+        pcfg = GH.ParallelConfig(dp=1, pp=1, tp=1, remat=True,
+                                 remat_policy=policy,
+                                 scan_unroll=unroll,
+                                 param_dtype=jnp.bfloat16,
+                                 compute_dtype=jnp.bfloat16,
+                                 moment_dtype=jnp.bfloat16)
+        try:
+            mesh, params, opt_state, _ = GH.setup(
+                cfg, pcfg, seed=0, devices=jax.devices()[:1])
+            grad_step, apply_step = GH.build_accum_steps(cfg, pcfg, mesh)
+            acc = GH.init_grad_accum(params)
+            with mesh:
+                acc, loss = grad_step(params, acc, (ids, ids))
+                params, opt_state, acc = apply_step(params, opt_state,
+                                                    acc, 1)
+                float(loss)
+                k, outer = 8, 2
+                t0 = time.perf_counter()
+                for _ in range(outer):
+                    for _ in range(k):
+                        acc, loss = grad_step(params, acc, (ids, ids))
+                    params, opt_state, acc = apply_step(
+                        params, opt_state, acc, k)
+                float(loss)
+                dt = (time.perf_counter() - t0) / outer
+                tok = b * seq * k / dt
+                print(f"{tag}: k={k} {dt*1e3:.0f} ms/window  "
+                      f"{tok:.0f} tok/s  loss={float(loss):.4f}",
+                      flush=True)
+            del params, opt_state, acc, grad_step, apply_step
+        except Exception as e:
+            print(f"{tag}: failed {type(e).__name__}: {e}"[:160],
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

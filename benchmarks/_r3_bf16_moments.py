@@ -1,0 +1,56 @@
+"""Timing: bf16 Adam moments vs f32 on the real chip (1.3B bench)."""
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.models import gpt_hybrid as GH
+
+    cfg = GPTConfig(vocab_size=50304, hidden_size=2048, num_layers=24,
+                    num_heads=16, max_seq_len=1024)
+    batch, seq, steps, warmup = 4, 1024, 6, 2
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+
+    results = {}
+    for tag, md in [("bf16-moments", jnp.bfloat16),
+                    ("f32-moments", jnp.float32),
+                    ("bf16-moments#2", jnp.bfloat16),
+                    ("f32-moments#2", jnp.float32)]:
+        pcfg = GH.ParallelConfig(dp=1, pp=1, tp=1, remat=True,
+                                 remat_policy="names", scan_unroll=24,
+                                 param_dtype=jnp.bfloat16,
+                                 compute_dtype=jnp.bfloat16,
+                                 moment_dtype=md)
+        try:
+            mesh, params, opt_state, step = GH.setup(
+                cfg, pcfg, seed=0, devices=jax.devices()[:1])
+        except Exception as e:
+            print(f"{tag}: setup/compile failed {type(e).__name__}",
+                  flush=True)
+            continue
+        with mesh:
+            for _ in range(warmup):
+                params, opt_state, loss = step(params, opt_state,
+                                               (ids, ids))
+            float(loss)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                params, opt_state, loss = step(params, opt_state,
+                                               (ids, ids))
+            float(loss)
+            dt = (time.perf_counter() - t0) / steps
+        print(f"{tag}: {dt*1e3:.1f} ms/step  "
+              f"{batch*seq/dt:.0f} tok/s  loss={float(loss):.4f}",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

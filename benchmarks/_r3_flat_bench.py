@@ -1,0 +1,58 @@
+"""Flat-accum engine throughput on the real chip (k=8, 1.3B)."""
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.models import gpt_hybrid as GH
+
+    cfg = GPTConfig(vocab_size=50304, hidden_size=2048, num_layers=24,
+                    num_heads=16, max_seq_len=1024)
+    seq = 1024
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (4, seq)))
+
+    for unroll, policy in ((24, 'names'), (1, 'names'), (1, 'full')):
+        try:
+            pcfg = GH.ParallelConfig(dp=1, pp=1, tp=1, remat=True,
+                                     remat_policy=policy,
+                                     scan_unroll=unroll,
+                                     param_dtype=jnp.bfloat16,
+                                     compute_dtype=jnp.bfloat16,
+                                     moment_dtype=jnp.bfloat16)
+            mesh = GH.build_mesh(pcfg, jax.devices()[:1])
+            init_state, train_window, _ = GH.build_flat_accum_bench(
+                cfg, pcfg, mesh)
+            pf, m, v, acc = init_state(seed=0)
+            k = 8
+            chunks = [(ids, ids)] * k
+            with mesh:
+                pf, m, v, acc, loss = train_window(pf, m, v, acc,
+                                                   chunks, 1, k)
+                float(loss)
+                t0 = time.perf_counter()
+                outer = 3
+                for w in range(outer):
+                    pf, m, v, acc, loss = train_window(
+                        pf, m, v, acc, chunks, 2 + w, k)
+                float(loss)
+                dt = (time.perf_counter() - t0) / outer
+            tok = 4 * seq * k / dt
+            print(f"unroll={unroll}/{policy} k={k}: {dt*1e3:.0f} "
+                  f"ms/window  {tok:.0f} tok/s  "
+                  f"loss={float(loss):.4f}", flush=True)
+            break
+        except Exception as e:
+            print(f"unroll={unroll}/{policy}: failed "
+                  f"{type(e).__name__}: {e}"[:160], flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Loss-curve parity: bf16 vs f32 Adam moments (CPU, medium config).
+
+The numerics gate for the bf16-moment perf lever: same init, same
+batches, 30 steps; report per-step relative deviation of the loss.
+"""
+import os
+import sys
+
+sys.path.insert(0, ".")
+
+
+def main():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from paddle_tpu._testing import force_cpu
+    force_cpu(pop_tpu=True)
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.models import gpt_hybrid as GH
+
+    cfg = GPTConfig(vocab_size=512, hidden_size=256, num_layers=4,
+                    num_heads=4, max_seq_len=128)
+    rng = np.random.RandomState(0)
+    batches = [jnp.asarray(rng.randint(0, 512, (4, 128)))
+               for _ in range(30)]
+
+    curves = {}
+    for tag, md in [("f32", jnp.float32), ("bf16", jnp.bfloat16)]:
+        pcfg = GH.ParallelConfig(dp=1, pp=1, tp=1, remat=False,
+                                 fused_ce=True,
+                                 param_dtype=jnp.float32,
+                                 compute_dtype=jnp.float32,
+                                 moment_dtype=md)
+        mesh, params, opt_state, step = GH.setup(
+            cfg, pcfg, seed=0, devices=jax.devices()[:1])
+        losses = []
+        with mesh:
+            for ids in batches:
+                params, opt_state, loss = step(params, opt_state,
+                                               (ids, ids))
+                losses.append(float(loss))
+        curves[tag] = np.asarray(losses)
+        print(f"{tag}: first={losses[0]:.5f} last={losses[-1]:.5f}",
+              flush=True)
+    rel = np.abs(curves["bf16"] - curves["f32"]) / np.abs(curves["f32"])
+    print(f"max rel deviation over 30 steps: {rel.max():.2e}")
+    print(f"mean rel deviation: {rel.mean():.2e}")
+    # the acc-align harness tolerance is 2e-3 at 5 steps; hold the
+    # bf16-moment drift to the same order across 30
+    assert rel.max() < 5e-3, rel.max()
+    print("PARITY OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,69 @@
+"""Spend bf16-moment memory savings on LESS rematerialization.
+
+With f32 moments the static state is 13 GB of 15.75 and 'names' (3
+saved tensors/layer) was the remat optimum. bf16 moments cut state to
+7.8 GB; this probes whether the freed 5+ GB buys back the ~recompute
+cost via richer save policies. Run one variant per process:
+  VARIANT=names|names5|dots|nof32names  python benchmarks/_r3_remat_budget.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.models import gpt_hybrid as GH
+
+    cfg = GPTConfig(vocab_size=50304, hidden_size=2048, num_layers=24,
+                    num_heads=16, max_seq_len=1024)
+    batch, seq, steps, warmup = 4, 1024, 6, 2
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+
+    variant = os.environ.get("VARIANT", "names")
+    kw = dict(moment_dtype=jnp.bfloat16)
+    if variant == "names":
+        kw.update(remat_policy="names")
+    elif variant == "names5":
+        kw.update(remat_policy="names",
+                  remat_save_names=("attn_out", "ffn1", "qkv", "proj",
+                                    "ffn2"))
+    elif variant == "dots":
+        kw.update(remat_policy="dots")
+    elif variant == "nof32names":
+        kw = dict(moment_dtype=jnp.float32, remat_policy="names")
+
+    pcfg = GH.ParallelConfig(dp=1, pp=1, tp=1, remat=True,
+                             scan_unroll=24,
+                             param_dtype=jnp.bfloat16,
+                             compute_dtype=jnp.bfloat16, **kw)
+    try:
+        mesh, params, opt_state, step = GH.setup(
+            cfg, pcfg, seed=0, devices=jax.devices()[:1])
+        with mesh:
+            for _ in range(warmup):
+                params, opt_state, loss = step(params, opt_state,
+                                               (ids, ids))
+            float(loss)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                params, opt_state, loss = step(params, opt_state,
+                                               (ids, ids))
+            float(loss)
+            dt = (time.perf_counter() - t0) / steps
+        print(f"{variant}: {dt*1e3:.1f} ms/step  "
+              f"{batch*seq/dt:.0f} tok/s", flush=True)
+    except Exception as e:
+        print(f"{variant}: failed {type(e).__name__}: {e}"[:200],
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

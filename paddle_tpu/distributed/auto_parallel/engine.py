@@ -141,8 +141,7 @@ class Engine:
         """Impose a tp/pp plan on the (unmodified) model via the
         partitioner (reference static/partitioner.py role)."""
         import paddle_tpu as paddle
-        from .partitioner import (PipelinePartition, annotate_tp,
-                                  find_pipeline_blocks)
+        from .partitioner import PipelinePartition, annotate_tp
         need = best.dp * best.pp * best.tp
         if need > len(self._devices):
             raise ValueError(f"plan {best.short()} needs {need} "
@@ -153,14 +152,18 @@ class Engine:
         if best.tp > 1:
             annotate_tp(self.model, self._mesh, "mp")
         if best.pp > 1:
-            blocks = find_pipeline_blocks(self.model)
+            blocks = self._pipeline_blocks()
             if not blocks:
                 raise NotImplementedError(
                     f"plan {best.short()} needs a homogeneous "
                     "LayerList/Sequential block chain for pipeline "
                     "partitioning (the reference PipelineLayer "
                     "contract); this model has none")
-            mbs = max(best.microbatches, 2 * best.pp)
+            # honor an explicitly planned microbatch count;
+            # microbatches=1 (the dataclass default) means "unset" and
+            # gets the bubble-friendly 2*pp
+            mbs = best.microbatches if best.microbatches > 1 \
+                else 2 * best.pp
             self._partition = PipelinePartition(
                 self.model, self.loss, blocks, self._mesh, best.pp,
                 microbatches=mbs)

@@ -72,11 +72,13 @@ def annotate_tp(model, mesh: Mesh, axis: str = "mp"):
             din, dout = w.shape
             if dout % tp == 0:
                 put(w, P(None, axis))
+                n += 1
                 if sub.bias is not None and sub.bias.shape[0] % tp == 0:
                     put(sub.bias, P(axis))
+                    n += 1
             elif din % tp == 0:
                 put(w, P(axis, None))
-            n += 1
+                n += 1
         elif isinstance(sub, Embedding):
             w = sub.weight
             if w.shape[1] % tp == 0:

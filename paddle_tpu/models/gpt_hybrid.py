@@ -81,6 +81,14 @@ class ParallelConfig:
     # inside the pp-manual 1F1B region — see _use_cm)
     collective_matmul: bool = False
     zero1: bool = True        # shard adam moments over dp
+    # Adam moment storage dtype. float32 is exact; bfloat16 HALVES the
+    # optimizer's HBM traffic (the update is bandwidth-bound: ~9% of a
+    # 1.3B step on v5e) at a small stochastic cost to the update
+    # direction — gated by the loss-parity harness
+    # (benchmarks/_r3_moment_parity.py + tests/test_acc_align.py
+    # tolerance); the update math stays f32 (moments are upcast,
+    # computed, and rounded back)
+    moment_dtype: Any = jnp.float32
     fused_ce: bool = True     # chunked LM-head+CE (ops/fused_ce.py);
                               # never materializes [T, V] logits
     scan_unroll: int = 1      # lax.scan unroll over layers (full unroll
@@ -478,7 +486,8 @@ def loss_fn(params, batch, cfg, pcfg, mesh):
 
 # --------------------------- optimizer -------------------------------------
 def adamw_init(params, pcfg, mesh, specs):
-    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    zeros = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, pcfg.moment_dtype), params)
     if pcfg.zero1 and pcfg.dp > 1:
         # ZeRO-1: moments sharded over dp on their largest dim
         def shard_moment(x, s):
@@ -498,18 +507,9 @@ def adamw_init(params, pcfg, mesh, specs):
 def adamw_update(params, grads, opt_state, lr=3e-4, b1=0.9, b2=0.95,
                  eps=1e-8, wd=0.1):
     step = opt_state["step"] + 1
-    sf = step.astype(jnp.float32)
-    c1 = 1 - b1 ** sf
-    c2 = 1 - b2 ** sf
 
     def upd(p, g, m, v):
-        g = g.astype(jnp.float32)
-        pf = p.astype(jnp.float32)
-        m_new = b1 * m.astype(jnp.float32) + (1 - b1) * g
-        v_new = b2 * v.astype(jnp.float32) + (1 - b2) * g * g
-        update = (m_new / c1) / (jnp.sqrt(v_new / c2) + eps) + wd * pf
-        return ((pf - lr * update).astype(p.dtype),
-                m_new.astype(m.dtype), v_new.astype(v.dtype))
+        return _adamw_leaf(p, m, v, g, step, lr, b1, b2, eps, wd)
 
     flat_p, tdef = jax.tree_util.tree_flatten(params)
     flat_g = jax.tree_util.tree_leaves(grads)
@@ -652,6 +652,230 @@ def build_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
         return new_params, new_opt, loss
 
     return jax.jit(train_step, donate_argnums=(0, 1))
+
+
+def build_accum_steps(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
+                      lr=3e-4):
+    """Two-program gradient accumulation (the split form of
+    gradient_merge_steps): `grad_step(params, acc, batch) -> (acc',
+    loss)` runs one microbatch's fwd+bwd and fuses the += into the
+    backward epilogue (acc donated — no extra HBM pass), and
+    `apply_step(params, opt_state, acc, k) -> (params', opt_state',
+    zeroed acc)` pays the bandwidth-bound AdamW update once per k
+    chunks. Each program's HLO stays bench-sized, which matters on
+    toolchains that choke on the k-times-larger fused-merge program."""
+    if pcfg.pp > 1:
+        raise NotImplementedError("accum steps: pp=1 engines only")
+
+    def grad_step(params, acc, batch):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, batch, cfg, pcfg, mesh))(params)
+        acc = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(a.dtype), acc, grads)
+        return acc, loss
+
+    def apply_step(params, opt_state, acc, k):
+        grads = jax.tree_util.tree_map(lambda a: a / k, acc)
+        new_p, new_o = adamw_update(params, grads, opt_state, lr=lr)
+        zeroed = jax.tree_util.tree_map(jnp.zeros_like, acc)
+        return new_p, new_o, zeroed
+
+    return (jax.jit(grad_step, donate_argnums=(1,)),
+            jax.jit(apply_step, donate_argnums=(0, 1, 2),
+                    static_argnums=(3,)))
+
+
+def init_grad_accum(params):
+    """Zeroed grad accumulator matching the param tree (param dtype —
+    bf16 accumulation over <=8 chunks is well within tolerance and
+    halves the accumulator's HBM)."""
+    return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+
+def _adamw_leaf(p, m, v, g, step, lr, b1=0.9, b2=0.95, eps=1e-8,
+                wd=0.1):
+    """The single home of the per-leaf AdamW update math (f32 compute,
+    storage dtypes preserved) — shared by adamw_update and the
+    accumulation bench engines so their parity is by construction."""
+    gf = g.astype(jnp.float32)
+    pf = p.astype(jnp.float32)
+    m_new = b1 * m.astype(jnp.float32) + (1 - b1) * gf
+    v_new = b2 * v.astype(jnp.float32) + (1 - b2) * gf * gf
+    sf = step.astype(jnp.float32) if hasattr(step, "astype") else \
+        jnp.float32(step)
+    c1 = 1 - b1 ** sf
+    c2 = 1 - b2 ** sf
+    upd = (m_new / c1) / (jnp.sqrt(v_new / c2) + eps) + wd * pf
+    return ((pf - lr * upd).astype(p.dtype),
+            m_new.astype(m.dtype), v_new.astype(v.dtype))
+
+
+def build_leaf_accum_bench(cfg: GPTConfig, pcfg: ParallelConfig,
+                           mesh: Mesh, lr=3e-4):
+    """Donation-free k-chunk training engine with PER-LEAF applies.
+
+    Every compiled program keeps in+out+temps well under HBM even when
+    the tunneled compile service drops buffer donation:
+      grad_acc(params, acc_tree, batch) -> (acc', loss)   (~13 GB peak)
+      apply_leaf(p, m, v, g, step, k) per stacked leaf    (<= ~6 GB)
+    The per-k apply also amortizes the bandwidth-bound AdamW update —
+    a larger-global-batch pretrain config (update math identical to
+    adamw_update; k=1 reproduces the classic step exactly, see
+    benchmarks/_r3_flat_parity.py).
+    """
+    def grad_acc(params, acc, batch):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, batch, cfg, pcfg, mesh))(params)
+        acc = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(a.dtype), acc, grads)
+        return acc, loss
+
+    def apply_leaf(p, m, v, g, step, k):
+        return _adamw_leaf(p, m, v, g / k, step, lr)
+
+    grad_acc_j = jax.jit(grad_acc, donate_argnums=(1,))
+
+    def grad_only(params, batch):
+        return jax.value_and_grad(
+            lambda p: loss_fn(p, batch, cfg, pcfg, mesh))(params)
+
+    grad_only_j = jax.jit(grad_only)
+    apply_j = jax.jit(apply_leaf, donate_argnums=(0, 1, 2),
+                      static_argnums=(5,))
+
+    def init_state(seed=0):
+        params = init_params(cfg, pcfg, jax.random.PRNGKey(seed))
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(pcfg.param_dtype), params)
+        m = jax.tree_util.tree_map(
+            lambda x: jnp.zeros(x.shape, pcfg.moment_dtype), params)
+        v = jax.tree_util.tree_map(
+            lambda x: jnp.zeros(x.shape, pcfg.moment_dtype), params)
+        acc = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return params, m, v, acc
+
+    def init_state_noacc(seed=0):
+        p_, m_, v_, _ = init_state(seed)
+        return p_, m_, v_, None
+
+    init_state.noacc = init_state_noacc
+
+    def train_window(params, m, v, acc, batches, step_no, k):
+        if k == 1 and acc is None:
+            # no-accumulator fast path: saves the 2.6 GB acc buffer —
+            # the minimum-footprint configuration
+            loss, gacc = grad_only_j(params, batches[0])
+        else:
+            for chunk in batches:
+                acc, loss = grad_acc_j(params, acc, chunk)
+            gacc = acc
+        stepa = jnp.asarray(step_no, jnp.float32)
+        pl, tdef = jax.tree_util.tree_flatten(params)
+        ml = jax.tree_util.tree_leaves(m)
+        vl = jax.tree_util.tree_leaves(v)
+        gl = jax.tree_util.tree_leaves(gacc)
+        had_acc = acc is not None
+        # release source trees so each leaf's old buffers free as its
+        # replacement lands (no donation needed to stay in budget)
+        del params, m, v, acc, gacc
+        for i in range(len(pl)):
+            po, mo, vo = apply_j(pl[i], ml[i], vl[i], gl[i], stepa, k)
+            pl[i], ml[i], vl[i] = po, mo, vo
+            # re-zero only when an accumulator persists; the noacc
+            # fast path must not materialize 2.6 GB of dead zeros
+            gl[i] = jnp.zeros_like(gl[i]) if had_acc else None
+        params = jax.tree_util.tree_unflatten(tdef, pl)
+        m = jax.tree_util.tree_unflatten(tdef, ml)
+        v = jax.tree_util.tree_unflatten(tdef, vl)
+        acc = jax.tree_util.tree_unflatten(tdef, gl) if had_acc \
+            else None
+        return params, m, v, acc, loss
+
+    return init_state, train_window
+
+
+def build_flat_accum_bench(cfg: GPTConfig, pcfg: ParallelConfig,
+                           mesh: Mesh, lr=3e-4):
+    """Donation-free benchmark engine: FLAT state vectors + k-chunk
+    gradient accumulation.
+
+    Motivation (measured on the tunneled v5e): the remote-compile
+    service intermittently switches to an AOT path that drops buffer
+    donation, so any program whose inputs+outputs carry the full
+    optimizer state (19-24 GB un-aliased) stops fitting in 15.75 GB
+    HBM. This engine keeps every program's in+out+temps under ~12 GB
+    WITHOUT donation:
+
+      grad_acc(params_flat, acc_flat, batch) -> (acc', loss)
+          params unflattened INSIDE the program (XLA slices/reshapes
+          are views — zero copy); grads flattened into one bf16 vector
+          accumulated over k microbatch chunks.
+      apply_half(p, m, v, g, step) x2 halves -> (p', m', v')
+          the uniform AdamW update on flat vector halves, paid once
+          per k chunks — which also amortizes the bandwidth-bound
+          optimizer (~25 ms) by k (a larger-global-batch pretrain
+          config; loss-parity of bf16 moments proven in
+          benchmarks/_r3_moment_parity.py).
+    """
+    tpl = init_params(cfg, pcfg, jax.random.PRNGKey(0))
+    leaves, treedef = jax.tree_util.tree_flatten(tpl)
+    shapes = [l.shape for l in leaves]
+    sizes = [int(np.prod(sh)) for sh in shapes]
+    offs = np.concatenate([[0], np.cumsum(sizes)]).tolist()
+    total = offs[-1]
+    half = ((total // 2) // 1024) * 1024
+
+    def unflatten(flat):
+        outs = []
+        for i, sh in enumerate(shapes):
+            outs.append(lax.dynamic_slice_in_dim(
+                flat, offs[i], sizes[i]).reshape(sh))
+        return jax.tree_util.tree_unflatten(treedef, outs)
+
+    def flatten_tree(tree):
+        ls = jax.tree_util.tree_leaves(tree)
+        return jnp.concatenate([l.reshape(-1) for l in ls])
+
+    def grad_acc(params_flat, acc_flat, batch):
+        params = unflatten(params_flat)
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, batch, cfg, pcfg, mesh))(params)
+        gflat = flatten_tree(grads).astype(acc_flat.dtype)
+        return acc_flat + gflat, loss
+
+    b1, b2, eps, wd = 0.9, 0.95, 1e-8, 0.1
+
+    def apply_half(p, m, v, g, step, k):
+        return _adamw_leaf(p, m, v, g / k, step, lr)
+
+    grad_acc_j = jax.jit(grad_acc, donate_argnums=(1,))
+    apply_j = jax.jit(apply_half, donate_argnums=(0, 1, 2),
+                      static_argnums=(5,))
+
+    def init_state(seed=0):
+        params = init_params(cfg, pcfg, jax.random.PRNGKey(seed))
+        pf = flatten_tree(params).astype(pcfg.param_dtype)
+        m = jnp.zeros((total,), pcfg.moment_dtype)
+        v = jnp.zeros((total,), pcfg.moment_dtype)
+        acc = jnp.zeros((total,), pcfg.param_dtype)
+        return pf, m, v, acc
+
+    def train_window(pf, m, v, acc, batches, step_no, k):
+        """k grad chunks + the split apply; returns new state+loss."""
+        for chunk in batches:
+            acc, loss = grad_acc_j(pf, acc, chunk)
+        stepa = jnp.asarray(step_no, jnp.float32)
+        outs = []
+        for lo_, hi_ in ((0, half), (half, total)):
+            ph, mh, vh, gh = (x[lo_:hi_] for x in (pf, m, v, acc))
+            outs.append(apply_j(ph, mh, vh, gh, stepa, k))
+        pf = jnp.concatenate([outs[0][0], outs[1][0]])
+        m = jnp.concatenate([outs[0][1], outs[1][1]])
+        v = jnp.concatenate([outs[0][2], outs[1][2]])
+        acc = jnp.zeros_like(acc)
+        return pf, m, v, acc, loss
+
+    return init_state, train_window, unflatten
 
 
 def setup(cfg: GPTConfig, pcfg: ParallelConfig, seed=0, devices=None):
