@@ -50,7 +50,7 @@ def main():
     # (HTTP 500, intermittent), so compile failures fall back to the
     # rolled loop instead of failing the bench. Partial unroll (4/8/12)
     # LOSES ~20% with fused CE — do not "compromise" on it.
-    def build(unroll, moment_dtype=jnp.float32, policy="names"):
+    def build(unroll, moment_dtype=None, policy="names"):
         pcfg = ParallelConfig(dp=1, pp=1, tp=1, remat=True,
                               remat_policy=policy, scan_unroll=unroll,
                               param_dtype=jnp.bfloat16,
@@ -71,7 +71,7 @@ def main():
     # NOTE: sync via scalar readback (float(loss)), not block_until_ready —
     # the tunneled PJRT backend acks block_until_ready before the device
     # actually finishes; a host readback is the only true barrier there.
-    def timed(unroll, moment_dtype=jnp.float32, policy="names"):
+    def timed(unroll, moment_dtype=None, policy="names"):
         mesh, params, opt_state, step = build(unroll, moment_dtype,
                                               policy)
         with mesh:
@@ -92,19 +92,19 @@ def main():
     # hbm accounting under which the f32-moment program (19.2G est.)
     # no longer fits — bf16 moments (~15G) do, with loss parity proven
     # exact to 1e-6/30 steps (benchmarks/_r3_moment_parity.py).
-    # f32-moment rungs are fastest (1.04-1.05x measured) but need the
-    # tunnel's donation-preserving compile path (in+out 19G aliased);
-    # when the service is in its strict-AOT/no-donation regime only the
-    # bf16-moment configs (~15G un-aliased) run — measured 0.83-0.84x,
-    # loss parity exact to 1e-6 (benchmarks/_r3_moment_parity.py).
-    # Regime history in NOTES.md round-3.
-    attempts = [(cfg.num_layers, jnp.float32, "names"),
-                (1, jnp.float32, "names"),
-                (cfg.num_layers, jnp.bfloat16, "names5"),
-                (1, jnp.bfloat16, "names5"),
-                (1, jnp.bfloat16, "full")]
+    # moments=None INHERITS the param dtype (bf16 here) — the exact
+    # round-2 configuration all recorded numbers ran under (a round-3
+    # f32-moment default briefly inflated the program by 5.2 GB and
+    # masqueraded as a tunnel regression — see NOTES). bf16-vs-f32
+    # moment parity: 1.45e-6 max rel dev over 30 steps measured,
+    # asserted < 5e-3 (benchmarks/_r3_moment_parity.py). Later rungs
+    # trade throughput for memory headroom.
+    attempts = [(cfg.num_layers, None, "names"),
+                (1, None, "names"),
+                (cfg.num_layers, None, "names5"),
+                (1, None, "full")]
     if on_cpu:
-        attempts = [(1, jnp.float32, "names")]
+        attempts = [(1, None, "names")]
     last = None
     for unroll, md, policy in attempts:
         if last is not None:
@@ -126,8 +126,8 @@ def main():
                 f"{e}")
             del e
             print(f"bench config (unroll={unroll}, moments="
-                  f"{md.__name__}, {policy}) failed; trying next",
-                  file=sys.stderr)
+                  f"{getattr(md, '__name__', md)}, {policy}) failed; "
+                  "trying next", file=sys.stderr)
     else:
         raise last
 

@@ -81,14 +81,14 @@ class ParallelConfig:
     # inside the pp-manual 1F1B region — see _use_cm)
     collective_matmul: bool = False
     zero1: bool = True        # shard adam moments over dp
-    # Adam moment storage dtype. float32 is exact; bfloat16 HALVES the
-    # optimizer's HBM traffic (the update is bandwidth-bound: ~9% of a
-    # 1.3B step on v5e) at a small stochastic cost to the update
-    # direction — gated by the loss-parity harness
-    # (benchmarks/_r3_moment_parity.py + tests/test_acc_align.py
-    # tolerance); the update math stays f32 (moments are upcast,
-    # computed, and rounded back)
-    moment_dtype: Any = jnp.float32
+    # Adam moment storage dtype. None (default) INHERITS the param
+    # dtype — the original zeros_like behavior every recorded bench ran
+    # under (bf16 moments for the bf16-param flagship). Explicit f32
+    # doubles moment HBM (+5.2 GB at 1.3B — does NOT fit v5e alongside
+    # the step's working set); parity of bf16 vs f32 moments measured
+    # at 1.45e-6 max rel deviation over 30 steps
+    # (benchmarks/_r3_moment_parity.py, asserted < 5e-3)
+    moment_dtype: Any = None
     fused_ce: bool = True     # chunked LM-head+CE (ops/fused_ce.py);
                               # never materializes [T, V] logits
     scan_unroll: int = 1      # lax.scan unroll over layers (full unroll
@@ -487,7 +487,8 @@ def loss_fn(params, batch, cfg, pcfg, mesh):
 # --------------------------- optimizer -------------------------------------
 def adamw_init(params, pcfg, mesh, specs):
     zeros = jax.tree_util.tree_map(
-        lambda p: jnp.zeros(p.shape, pcfg.moment_dtype), params)
+        lambda p: jnp.zeros(p.shape, pcfg.moment_dtype or p.dtype),
+        params)
     if pcfg.zero1 and pcfg.dp > 1:
         # ZeRO-1: moments sharded over dp on their largest dim
         def shard_moment(x, s):
@@ -654,6 +655,18 @@ def build_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
     return jax.jit(train_step, donate_argnums=(0, 1))
 
 
+def _make_grad_acc(cfg, pcfg, mesh):
+    """One home for the accumulate-into-tree gradient step shared by
+    the accumulation engines (parity by construction)."""
+    def grad_acc(params, acc, batch):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, batch, cfg, pcfg, mesh))(params)
+        acc = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(a.dtype), acc, grads)
+        return acc, loss
+    return grad_acc
+
+
 def build_accum_steps(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
                       lr=3e-4):
     """Two-program gradient accumulation (the split form of
@@ -667,12 +680,7 @@ def build_accum_steps(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
     if pcfg.pp > 1:
         raise NotImplementedError("accum steps: pp=1 engines only")
 
-    def grad_step(params, acc, batch):
-        loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(p, batch, cfg, pcfg, mesh))(params)
-        acc = jax.tree_util.tree_map(
-            lambda a, g: a + g.astype(a.dtype), acc, grads)
-        return acc, loss
+    grad_step = _make_grad_acc(cfg, pcfg, mesh)
 
     def apply_step(params, opt_state, acc, k):
         grads = jax.tree_util.tree_map(lambda a: a / k, acc)
@@ -748,9 +756,11 @@ def build_leaf_accum_bench(cfg: GPTConfig, pcfg: ParallelConfig,
         params = jax.tree_util.tree_map(
             lambda x: x.astype(pcfg.param_dtype), params)
         m = jax.tree_util.tree_map(
-            lambda x: jnp.zeros(x.shape, pcfg.moment_dtype), params)
+            lambda x: jnp.zeros(x.shape, pcfg.moment_dtype or x.dtype),
+            params)
         v = jax.tree_util.tree_map(
-            lambda x: jnp.zeros(x.shape, pcfg.moment_dtype), params)
+            lambda x: jnp.zeros(x.shape, pcfg.moment_dtype or x.dtype),
+            params)
         acc = jax.tree_util.tree_map(jnp.zeros_like, params)
         return params, m, v, acc
 
@@ -761,6 +771,11 @@ def build_leaf_accum_bench(cfg: GPTConfig, pcfg: ParallelConfig,
     init_state.noacc = init_state_noacc
 
     def train_window(params, m, v, acc, batches, step_no, k):
+        if k != len(batches):
+            raise ValueError(f"k={k} but {len(batches)} batches")
+        if acc is None and k > 1:
+            raise ValueError("k>1 needs the accumulator: use "
+                             "init_state(), not init_state.noacc()")
         if k == 1 and acc is None:
             # no-accumulator fast path: saves the 2.6 GB acc buffer —
             # the minimum-footprint configuration
@@ -817,7 +832,8 @@ def build_flat_accum_bench(cfg: GPTConfig, pcfg: ParallelConfig,
           config; loss-parity of bf16 moments proven in
           benchmarks/_r3_moment_parity.py).
     """
-    tpl = init_params(cfg, pcfg, jax.random.PRNGKey(0))
+    tpl = jax.eval_shape(
+        lambda: init_params(cfg, pcfg, jax.random.PRNGKey(0)))
     leaves, treedef = jax.tree_util.tree_flatten(tpl)
     shapes = [l.shape for l in leaves]
     sizes = [int(np.prod(sh)) for sh in shapes]
@@ -843,8 +859,6 @@ def build_flat_accum_bench(cfg: GPTConfig, pcfg: ParallelConfig,
         gflat = flatten_tree(grads).astype(acc_flat.dtype)
         return acc_flat + gflat, loss
 
-    b1, b2, eps, wd = 0.9, 0.95, 1e-8, 0.1
-
     def apply_half(p, m, v, g, step, k):
         return _adamw_leaf(p, m, v, g / k, step, lr)
 
@@ -855,8 +869,9 @@ def build_flat_accum_bench(cfg: GPTConfig, pcfg: ParallelConfig,
     def init_state(seed=0):
         params = init_params(cfg, pcfg, jax.random.PRNGKey(seed))
         pf = flatten_tree(params).astype(pcfg.param_dtype)
-        m = jnp.zeros((total,), pcfg.moment_dtype)
-        v = jnp.zeros((total,), pcfg.moment_dtype)
+        md = pcfg.moment_dtype or pcfg.param_dtype
+        m = jnp.zeros((total,), md)
+        v = jnp.zeros((total,), md)
         acc = jnp.zeros((total,), pcfg.param_dtype)
         return pf, m, v, acc
 

@@ -9,6 +9,10 @@ import numpy as np
 from paddle_tpu.io import Dataset
 from paddle_tpu.nn.layer.layers import Layer
 from paddle_tpu.ops.extra import viterbi_decode  # noqa: F401
+from paddle_tpu.core.string_tensor import (  # noqa: F401
+    StringTensor, strings_empty, strings_lower, strings_upper)
+from paddle_tpu.text.tokenizer import (  # noqa: F401
+    BasicTokenizer, FasterTokenizer, WordpieceTokenizer)
 
 
 class ViterbiDecoder(Layer):

@@ -1,0 +1,82 @@
+"""StringTensor + string kernels + FasterTokenizer (reference:
+phi/core/string_tensor.h, phi/kernels/strings/*, faster_tokenizer_op.h
+— the last SURVEY 2.1 'absent' row)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.string_tensor import (StringTensor, strings_empty,
+                                           strings_lower, strings_upper)
+from paddle_tpu.text import BasicTokenizer, FasterTokenizer
+
+
+def test_string_tensor_basics():
+    t = StringTensor([["ab", "cd"], ["ef", "GH"]])
+    assert t.shape == [2, 2] and t.numel() == 4
+    assert t.dtype == "pstring" and t.place == "cpu"
+    assert t[1, 1] == "GH"
+    row = t[0]
+    assert isinstance(row, StringTensor) and row.tolist() == ["ab", "cd"]
+    e = strings_empty([3])
+    assert e.tolist() == ["", "", ""]
+    c = strings_empty([2, 2]).copy_(t)
+    assert c == t
+
+
+def test_strings_case_kernels_unicode():
+    t = StringTensor(["Hello", "ÀÉÎ", "Straße", "中文Mix"])
+    low = strings_lower(t)
+    assert low.tolist() == ["hello", "àéî", "straße", "中文mix"]
+    up = strings_upper(t)
+    assert up.tolist()[0] == "HELLO"
+    assert up.tolist()[1] == "ÀÉÎ"
+    # ascii-only mode leaves non-ascii untouched (reference non-utf8
+    # path)
+    low_ascii = strings_lower(StringTensor(["ÀBC"]),
+                              use_utf8_encoding=False)
+    assert low_ascii.tolist() == ["Àbc"]
+
+
+def test_basic_tokenizer():
+    bt = BasicTokenizer(do_lower_case=True)
+    assert bt.tokenize("Hello, World!") == ["hello", ",", "world", "!"]
+    # accents stripped, CJK split per char
+    assert bt.tokenize("Café 中文") == ["cafe", "中", "文"]
+
+
+VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "un", "##aff", "##able",
+         "hello", "world", ",", "!", "the"]
+
+
+def test_wordpiece_and_faster_tokenizer():
+    tok = FasterTokenizer(VOCAB)
+    ids, tt = tok("Hello, unaffable world!")
+    v = {t: i for i, t in enumerate(VOCAB)}
+    expect = [v["[CLS]"], v["hello"], v[","], v["un"], v["##aff"],
+              v["##able"], v["world"], v["!"], v["[SEP]"]]
+    np.testing.assert_array_equal(ids.numpy()[0], expect)
+    assert ids.dtype.name in ("int64", "int32")
+    # unknown word -> [UNK]
+    ids2, _ = tok("zzz")
+    assert v["[UNK]"] in ids2.numpy()[0]
+
+
+def test_faster_tokenizer_pairs_padding_and_device_handoff():
+    tok = FasterTokenizer(VOCAB)
+    ids, tt = tok(["hello", "hello world"], text_pair=["world", "the"],
+                  max_seq_len=8, pad_to_max_seq_len=True)
+    assert ids.shape == [2, 8]
+    # token_type marks the second segment
+    assert tt.numpy()[0].max() == 1
+    # ids feed straight into device-side embedding (the whole point)
+    emb = paddle.nn.Embedding(len(VOCAB), 4)
+    out = emb(ids)
+    assert out.shape == [2, 8, 4]
+
+
+def test_string_tensor_input_to_tokenizer():
+    from paddle_tpu.core.string_tensor import StringTensor
+    tok = FasterTokenizer(VOCAB)
+    st = StringTensor(["hello world", "the un"])
+    ids, _ = tok(st)
+    assert ids.shape[0] == 2
