@@ -24,11 +24,15 @@ def main():
     seq, steps, warmup = 1024, 4, 2
     rng = np.random.RandomState(0)
 
-    for k in [1, 2, 4]:
+    import os
+    for k in [int(x) for x in os.environ.get('KS', '1,2,4').split(',')]:
         batch = 4 * k
         ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+        import os
+        unroll = int(os.environ.get("UNROLL", "1"))
         pcfg = GH.ParallelConfig(dp=1, pp=1, tp=1, remat=True,
-                                 remat_policy="names", scan_unroll=24,
+                                 remat_policy="names",
+                                 scan_unroll=unroll,
                                  gradient_merge_steps=k,
                                  param_dtype=jnp.bfloat16,
                                  compute_dtype=jnp.bfloat16)
