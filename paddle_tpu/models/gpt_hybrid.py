@@ -485,24 +485,65 @@ def loss_fn(params, batch, cfg, pcfg, mesh):
 
 
 # --------------------------- optimizer -------------------------------------
-def adamw_init(params, pcfg, mesh, specs):
+def moment_specs(params, pcfg, specs):
+    """P-spec tree for the Adam moments: the param spec, with ZeRO-1
+    additionally sharding each not-already-dp-sharded leaf over dp on
+    its first divisible dim (DygraphShardingOptimizer's rank-ownership,
+    expressed as a sharding instead of per-rank slicing)."""
+    def spec_of(x, s):
+        entry = list(tuple(s)) + [None] * (x.ndim - len(tuple(s)))
+        if pcfg.zero1 and pcfg.dp > 1 and \
+                "dp" not in jax.tree_util.tree_leaves(entry):
+            dims = [i for i, e in enumerate(entry) if e is None
+                    and x.shape[i] % pcfg.dp == 0]
+            if dims:
+                entry[dims[0]] = "dp"
+        return P(*entry)
+    return jax.tree_util.tree_map(spec_of, params, specs)
+
+
+def adamw_init(params, pcfg, mesh, specs, mspecs=None):
     zeros = jax.tree_util.tree_map(
         lambda p: jnp.zeros(p.shape, pcfg.moment_dtype or p.dtype),
         params)
-    if pcfg.zero1 and pcfg.dp > 1:
-        # ZeRO-1: moments sharded over dp on their largest dim
-        def shard_moment(x, s):
-            entry = list(tuple(s)) + [None] * (x.ndim - len(tuple(s)))
-            if "dp" not in jax.tree_util.tree_leaves(entry):
-                dims = [i for i, e in enumerate(entry) if e is None
-                        and x.shape[i] % pcfg.dp == 0]
-                if dims:
-                    entry[dims[0]] = "dp"
-            return jax.device_put(x, NamedSharding(mesh, P(*entry)))
-        zeros = jax.tree_util.tree_map(shard_moment, zeros, specs)
+    if mesh is not None:
+        # commit every piece of state to the mesh: an UNcommitted moment
+        # tree makes the first jitted step's outputs (which carry the
+        # mesh context) a different cache key than the inputs — i.e. a
+        # silent SECOND compile of the full train program
+        # (tests/test_perf_gate.py::test_train_step_executable_count_stable)
+        # mspecs, when passed by setup, is the SAME tree that pins the
+        # step's out_shardings — input and output shardings agree
+        # structurally, not by parallel construction
+        if mspecs is None:
+            if specs is None:
+                # legacy callers passed specs=None when it was dead
+                # (dp=1 / zero1 off): moments replicate
+                specs = jax.tree_util.tree_map(lambda _: P(), params)
+            mspecs = moment_specs(params, pcfg, specs)
+        zeros = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            zeros, mspecs)
+        step0 = jax.device_put(jnp.zeros((), jnp.int32),
+                               NamedSharding(mesh, P()))
+    else:
+        step0 = jnp.zeros((), jnp.int32)
     return {"m": zeros,
             "v": jax.tree_util.tree_map(jnp.zeros_like, zeros),
-            "step": jnp.zeros((), jnp.int32)}
+            "step": step0}
+
+
+def _state_out_shardings(mesh, pspecs, mspecs):
+    """(params, opt_state, scalar) NamedSharding trees — the ONE home of
+    the train-state output-sharding layout shared by every jitted engine
+    (build_train_step, build_accum_steps)."""
+    def ns(tree):
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), tree)
+    scalar = NamedSharding(mesh, P())
+    return (ns(pspecs),
+            {"m": ns(mspecs), "v": ns(mspecs), "step": scalar},
+            scalar)
 
 
 def adamw_update(params, grads, opt_state, lr=3e-4, b1=0.9, b2=0.95,
@@ -599,7 +640,7 @@ def _train_grads_1f1b(params, batch, cfg, pcfg, mesh):
 
 
 def build_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
-                     lr=3e-4):
+                     lr=3e-4, state_specs=None):
     if pcfg.pp_schedule not in ("gpipe", "1f1b"):
         raise ValueError(
             f"pp_schedule must be 'gpipe' or '1f1b', got "
@@ -616,6 +657,15 @@ def build_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
         def grads_of(params, batch):
             return jax.value_and_grad(
                 lambda p: loss_fn(p, batch, cfg, pcfg, mesh))(params)
+
+    # pin the step's outputs to the INPUT state shardings: left to
+    # GSPMD, the output spec can drift (e.g. wte P('tp',None) ->
+    # P(None,'tp')), which both reshards every step and makes the
+    # second call a new executable-cache entry (a silent double compile
+    # of the full program — caught by tests/test_perf_gate.py)
+    out_sh = None
+    if state_specs is not None:
+        out_sh = _state_out_shardings(mesh, *state_specs)
 
     k = pcfg.gradient_merge_steps
     if k > 1:
@@ -645,14 +695,16 @@ def build_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
                                                lr=lr)
             return new_params, new_opt, lsum / k
 
-        return jax.jit(train_step, donate_argnums=(0, 1))
+        return jax.jit(train_step, donate_argnums=(0, 1),
+                       out_shardings=out_sh)
 
     def train_step(params, opt_state, batch):
         loss, grads = grads_of(params, batch)
         new_params, new_opt = adamw_update(params, grads, opt_state, lr=lr)
         return new_params, new_opt, loss
 
-    return jax.jit(train_step, donate_argnums=(0, 1))
+    return jax.jit(train_step, donate_argnums=(0, 1),
+                   out_shardings=out_sh)
 
 
 def _make_grad_acc(cfg, pcfg, mesh):
@@ -668,7 +720,7 @@ def _make_grad_acc(cfg, pcfg, mesh):
 
 
 def build_accum_steps(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
-                      lr=3e-4):
+                      lr=3e-4, state_specs=None):
     """Two-program gradient accumulation (the split form of
     gradient_merge_steps): `grad_step(params, acc, batch) -> (acc',
     loss)` runs one microbatch's fwd+bwd and fuses the += into the
@@ -688,9 +740,16 @@ def build_accum_steps(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
         zeroed = jax.tree_util.tree_map(jnp.zeros_like, acc)
         return new_p, new_o, zeroed
 
-    return (jax.jit(grad_step, donate_argnums=(1,)),
+    # pin output shardings for the same reason as build_train_step:
+    # GSPMD output-spec drift would reshard per call AND double-compile
+    gs_out = ap_out = None
+    if state_specs is not None:
+        psh, osh, scalar = _state_out_shardings(mesh, *state_specs)
+        gs_out = (psh, scalar)
+        ap_out = (psh, osh, psh)
+    return (jax.jit(grad_step, donate_argnums=(1,), out_shardings=gs_out),
             jax.jit(apply_step, donate_argnums=(0, 1, 2),
-                    static_argnums=(3,)))
+                    static_argnums=(3,), out_shardings=ap_out))
 
 
 def init_grad_accum(params):
@@ -900,6 +959,8 @@ def setup(cfg: GPTConfig, pcfg: ParallelConfig, seed=0, devices=None):
     params = init_params(cfg, pcfg, key)
     with mesh:
         params, specs = shard_params(params, mesh, cfg, pcfg)
-        opt_state = adamw_init(params, pcfg, mesh, specs)
-    step_fn = build_train_step(cfg, pcfg, mesh, lr=3e-4)
+        mspecs = moment_specs(params, pcfg, specs)
+        opt_state = adamw_init(params, pcfg, mesh, specs, mspecs=mspecs)
+    step_fn = build_train_step(cfg, pcfg, mesh, lr=3e-4,
+                               state_specs=(specs, mspecs))
     return mesh, params, opt_state, step_fn

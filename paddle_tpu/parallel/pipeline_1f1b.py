@@ -173,8 +173,13 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params, x_microbatches,
             lambda g: lax.psum(jnp.where(s == n - 1, g,
                                          jnp.zeros_like(g)), axis_name),
             head)
-    dx0 = lax.psum(jnp.where(s == 0, dx0_buf, jnp.zeros_like(dx0_buf)),
-                   axis_name)
+    # psum in f32: a bf16 dx0 all-reduce gets combined with the f32
+    # grad all-reduces into one variadic op, and XLA:CPU's
+    # AllReducePromotion pass CHECK-crashes cloning a mixed-dtype
+    # variadic all-reduce (TPU is unaffected; uniform f32 sidesteps it)
+    dx0 = lax.psum(
+        jnp.where(s == 0, dx0_buf, jnp.zeros_like(dx0_buf))
+        .astype(grad_dtype), axis_name).astype(dtype)
     grads = jax.tree_util.tree_map(lambda g: g[None], grads)
     return loss, grads, head, dx0
 
@@ -358,7 +363,8 @@ def pipeline_train_interleaved(stage_fn: Callable, stage_params,
             lambda g: lax.psum(jnp.where(s == n - 1, g,
                                          jnp.zeros_like(g)),
                                axis_name), head)
-    dx0 = lax.psum(jnp.where(s == 0, dx0_buf, jnp.zeros_like(dx0_buf)),
-                   axis_name)
+    dx0 = lax.psum(
+        jnp.where(s == 0, dx0_buf, jnp.zeros_like(dx0_buf))
+        .astype(grad_dtype), axis_name).astype(dtype)
     grads = jax.tree_util.tree_map(lambda g: g[None], grads)
     return loss, grads, head, dx0
