@@ -206,7 +206,8 @@ class DecodeSession:
     """
 
     def __init__(self, model, max_length, prefill_buckets=None,
-                 temperature=0.0, top_p=None, eos_token_id=None):
+                 temperature=0.0, top_p=None, eos_token_id=None,
+                 decode_block=None):
         model.eval()
         self._model = model
         self._max_length = int(max_length)
@@ -217,6 +218,14 @@ class DecodeSession:
         self._eos = eos_token_id
         self._buckets = [min(b, self._max_length) for b in self._buckets]
         self._state = self._collect_state()
+        # decode_block > 1 selects the SINGLE-PROGRAM multi-token loop:
+        # one lax.while_loop program emits a [B, decode_block] token
+        # block per dispatch, so decode throughput is independent of
+        # host<->device round-trip latency (the per-token dispatch loop
+        # serializes on RTT over a tunneled transport). The reference
+        # gets the same effect by fusing the whole decode stack into
+        # fused_multi_transformer's one-kernel-per-token loop.
+        self._decode_block = int(decode_block) if decode_block else None
         # one jitted decode step; cache buffers donated (decode args are
         # (*state, token, key, *cache_leaves) -> caches start at n+2)
         n_state = len(self._state)
@@ -224,6 +233,12 @@ class DecodeSession:
             self._decode_pure,
             donate_argnums=tuple(range(n_state + 2,
                                        n_state + 2 + self._n_cache_leaves)))
+        # block program args: (*state, token, key, finished, m,
+        # *cache_leaves) -> caches start at n+4
+        self._decode_block_jit = jax.jit(
+            self._decode_block_pure,
+            donate_argnums=tuple(range(n_state + 4,
+                                       n_state + 4 + self._n_cache_leaves)))
         self._prefill_jit = jax.jit(self._prefill_pure)
 
     # -- state plumbing (same discipline as jit.StaticFunction) ---------
@@ -300,6 +315,46 @@ class DecodeSession:
                            self._top_p)
         return nxt, key, cache_out
 
+    def _decode_block_pure(self, *flat):
+        """Up to ``decode_block`` decode steps in ONE program: a
+        lax.while_loop carrying (token, key, finished, out, caches) that
+        exits early when every sequence has emitted eos — the early-exit
+        check rides ON DEVICE instead of costing a host sync. ``m``
+        (actual steps wanted) is a traced operand, so short final blocks
+        reuse the same executable."""
+        n = len(self._state)
+        state = flat[:n]
+        token, key, finished, m = flat[n:n + 4]
+        cache_arrays = tuple(flat[n + 4:])
+        blk = self._decode_block
+        eos = self._eos
+        fill = jnp.int32(eos if eos is not None else 0)
+        out0 = jnp.full((token.shape[0], blk), fill)
+
+        def cond(carry):
+            i, _token, _key, fin, _out, _caches = carry
+            live = i < m
+            if eos is not None:
+                live = live & ~jnp.all(fin)
+            return live
+
+        def body(carry):
+            i, token, key, fin, out, caches = carry
+            logits, cache_out = self._run_model(state, token[:, None],
+                                                caches)
+            nxt, key = _sample(logits[:, -1], key, self._temperature,
+                               self._top_p)
+            if eos is not None:
+                nxt = jnp.where(fin, jnp.int32(eos), nxt)
+                fin = fin | (nxt == eos)
+            out = out.at[:, i].set(nxt)
+            return (i + 1, nxt, key, fin, out, tuple(cache_out))
+
+        carry = (jnp.int32(0), token, key, finished, out0, cache_arrays)
+        _i, token, key, finished, out, cache_arrays = lax.while_loop(
+            cond, body, carry)
+        return out, token, key, finished, list(cache_arrays)
+
     # -- public API -----------------------------------------------------
     def generate(self, input_ids, max_new_tokens=16, seed=None):
         """Generate tokens; returns [B, prompt + n_generated] ids.
@@ -341,6 +396,14 @@ class DecodeSession:
         finished = jnp.zeros((b,), bool) if self._eos is not None else None
         if finished is not None:
             finished = finished | (token == self._eos)
+
+        if self._decode_block:
+            gen = self._generate_blocks(state, token, key, finished,
+                                        cache_arrays, b,
+                                        max_new_tokens - 1)
+            return Tensor._wrap(jnp.concatenate([ids, gen], axis=1),
+                                True)
+
         outs = [token]
         for i in range(max_new_tokens - 1):
             token, key, cache_arrays = self._decode_jit(
@@ -357,16 +420,44 @@ class DecodeSession:
         gen = jnp.stack(outs, axis=1)
         return Tensor._wrap(jnp.concatenate([ids, gen], axis=1), True)
 
+    def _generate_blocks(self, state, token, key, finished, cache_arrays,
+                         b, m_total):
+        """Drive the single-program block decoder: one dispatch per
+        ``decode_block`` tokens (host RTT amortized by the block size);
+        a finished batch stops between blocks and back-fills eos, which
+        matches the per-step path's eos pinning token-for-token."""
+        blk = self._decode_block
+        if finished is None:
+            finished = jnp.zeros((b,), bool)
+        outs = [token[:, None]]
+        done = 0
+        while done < m_total:
+            m = min(blk, m_total - done)
+            toks, token, key, finished, cache_arrays = \
+                self._decode_block_jit(*state, token, key, finished,
+                                       jnp.int32(m), *cache_arrays)
+            outs.append(toks[:, :m])
+            done += m
+            if self._eos is not None and done < m_total and bool(
+                    jax.device_get(jnp.all(finished))):
+                outs.append(jnp.full((b, m_total - done),
+                                     jnp.int32(self._eos)))
+                break
+        return jnp.concatenate(outs, axis=1)
+
     def executable_counts(self):
         """(n_prefill_executables, n_decode_executables) — the decode
-        count must stay 1 however many tokens are generated."""
+        count must stay 1 however many tokens are generated. In block
+        mode the block program is THE decode executable (the per-step
+        one goes unused), so the counts are summed."""
         return (self._prefill_jit._cache_size(),
-                self._decode_jit._cache_size())
+                self._decode_jit._cache_size()
+                + self._decode_block_jit._cache_size())
 
 
 def cached_generate(model, input_ids, max_new_tokens=16, temperature=0.0,
                     top_p=None, seed=None, max_length=None, seq_ceiling=None,
-                    hard_limit=False):
+                    hard_limit=False, decode_block=None):
     """Shared model.generate() implementation: pick a cache capacity
     (next power of two covering prompt+new, floored at 64), cache one
     DecodeSession per (capacity, sampling config) on the model, and
@@ -386,9 +477,10 @@ def cached_generate(model, input_ids, max_new_tokens=16, temperature=0.0,
         max(seq_ceiling or 0, need)
     cap = max_length or min(max(64, 1 << (need - 1).bit_length()),
                             ceil_eff)
-    key = (cap, float(temperature), top_p)
+    key = (cap, float(temperature), top_p, decode_block)
     sessions = model.__dict__.setdefault("_decode_sessions", {})
     if key not in sessions:
         sessions[key] = DecodeSession(model, cap, temperature=temperature,
-                                      top_p=top_p)
+                                      top_p=top_p,
+                                      decode_block=decode_block)
     return sessions[key].generate(input_ids, max_new_tokens, seed=seed)

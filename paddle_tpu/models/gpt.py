@@ -187,7 +187,8 @@ class GPTForCausalLM(nn.Layer):
 
     @paddle.no_grad()
     def generate(self, input_ids, max_new_tokens=16, temperature=0.0,
-                 top_p=None, seed=None, max_length=None):
+                 top_p=None, seed=None, max_length=None,
+                 decode_block=None):
         """Compiled static-shape generation over the fixed-capacity KV
         cache (see inference/decode.py)."""
         from paddle_tpu.inference.decode import cached_generate
@@ -196,5 +197,6 @@ class GPTForCausalLM(nn.Layer):
         return cached_generate(self, input_ids, max_new_tokens,
                                temperature=temperature, top_p=top_p,
                                seed=seed, max_length=max_length,
+                               decode_block=decode_block,
                                seq_ceiling=self.gpt.cfg.max_seq_len,
                                hard_limit=True)

@@ -232,7 +232,8 @@ class LlamaForCausalLM(nn.Layer):
 
     @paddle.no_grad()
     def generate(self, input_ids, max_new_tokens=16, temperature=0.0,
-                 top_p=None, seed=None, max_length=None):
+                 top_p=None, seed=None, max_length=None,
+                 decode_block=None):
         """Compiled static-shape generation (decode = ONE executable
         reused every token; the cache is a donated fixed-capacity buffer
         updated with dynamic_update_slice). Replaces the round-2
@@ -242,5 +243,6 @@ class LlamaForCausalLM(nn.Layer):
         return cached_generate(self, input_ids, max_new_tokens,
                                temperature=temperature, top_p=top_p,
                                seed=seed, max_length=max_length,
+                               decode_block=decode_block,
                                seq_ceiling=self.llama.cfg.max_seq_len,
                                hard_limit=False)

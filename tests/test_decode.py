@@ -272,3 +272,46 @@ def test_predictor_generate_serving(tiny_llama):
     ref = _legacy_greedy(tiny_llama, ids, 5)
     np.testing.assert_array_equal(ref, out.numpy())
     assert pred.stats["runs"] == 1
+
+
+def test_block_decode_exact_parity_with_per_step(tiny_llama):
+    """The single-program lax.while_loop block decoder must emit exactly
+    the tokens of the per-step path (greedy), with ONE decode
+    executable however many blocks run (short final block included —
+    the step count is a traced operand, not a shape)."""
+    m = tiny_llama
+    paddle.seed(11)
+    ids = paddle.randint(0, 256, [2, 8])
+    ref = m.generate(ids, max_new_tokens=13, temperature=0.0).numpy()
+    out = m.generate(ids, max_new_tokens=13, temperature=0.0,
+                     decode_block=4).numpy()
+    np.testing.assert_array_equal(ref, out)
+    sess = next(s for k, s in m._decode_sessions.items() if k[3] == 4)
+    pre, dec = sess.executable_counts()
+    assert dec == 1
+    # different lengths / prompts reuse the same block executable
+    out2 = m.generate(ids, max_new_tokens=6, temperature=0.0,
+                      decode_block=4).numpy()
+    ref2 = m.generate(ids, max_new_tokens=6, temperature=0.0).numpy()
+    np.testing.assert_array_equal(ref2, out2)
+    assert sess.executable_counts()[1] == 1
+
+
+def test_block_decode_eos_early_exit(tiny_llama):
+    """All-finished batches stop dispatching blocks and back-fill eos —
+    token-for-token identical to the per-step path's pinning."""
+    from paddle_tpu.inference.decode import DecodeSession
+    m = tiny_llama
+    paddle.seed(12)
+    ids = paddle.randint(0, 256, [2, 6])
+    probe = DecodeSession(m, 64).generate(ids, max_new_tokens=6).numpy()
+    eos = int(probe[0, 7])
+    ref = DecodeSession(m, 64, eos_token_id=eos).generate(
+        ids, max_new_tokens=20).numpy()
+    sess = DecodeSession(m, 64, eos_token_id=eos, decode_block=4)
+    out = sess.generate(ids, max_new_tokens=20).numpy()
+    gen = out[0, 6:]
+    hit = np.argmax(gen == eos)
+    assert (gen[hit:] == eos).all(), gen
+    np.testing.assert_array_equal(ref[1], out[1])
+    assert sess.executable_counts()[1] == 1
