@@ -1,0 +1,188 @@
+"""Tensor-array API: create_array / array_read / array_write /
+array_length.
+
+Reference being re-designed: python/paddle/tensor/array.py:43 (length),
+:110 (read), :206 (write), :308 (create) — the LOD_TENSOR_ARRAY that the
+reference's dy2static uses for while-loop-carried list state.
+
+TPU-first design. In eager mode the array is a plain Python list of
+Tensors (exactly the reference's dynamic mode). Under a trace, XLA has
+no dynamically-sized container — the idiomatic equivalent is a
+FIXED-CAPACITY stacked buffer plus a length counter, carried through
+``lax`` ops (the same static-capacity discipline as the serving KV
+cache, inference/decode.py). ``StaticTensorArray`` is that carrier: a
+registered pytree, so it flows through ``paddle.static.nn.while_loop``
+/ ``jit.to_static`` loop state unchanged, and reads/writes at TRACED
+indices lower to ``dynamic_slice`` / ``dynamic_update_slice``.
+
+A plain list still works inside a trace as long as indices are concrete
+Python ints (the unrolled dy2static case); a traced index on a list
+raises with a pointer to ``create_array(..., capacity=)``.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.core import dtype as dtype_mod
+
+__all__ = ["create_array", "array_length", "array_read", "array_write",
+           "StaticTensorArray"]
+
+
+@jax.tree_util.register_pytree_node_class
+class StaticTensorArray:
+    """Fixed-capacity tensor array: ``stack`` [capacity, *element_shape]
+    + ``length`` (0-D int64, count of written slots). A pytree, so it
+    can be a while_loop carry / scan state."""
+
+    def __init__(self, stack, length):
+        self._stack = stack      # Tensor [capacity, ...]
+        self._length = length    # Tensor 0-D int64
+
+    @property
+    def capacity(self):
+        return int(self._stack.shape[0])
+
+    def tree_flatten(self):
+        return (self._stack, self._length), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def __repr__(self):
+        return (f"StaticTensorArray(capacity={self.capacity}, "
+                f"element_shape={tuple(self._stack.shape[1:])})")
+
+
+def _as_arr(v):
+    return v._data if isinstance(v, Tensor) else jnp.asarray(v)
+
+
+def _index(i):
+    """Reference contract: i is a 0-D or shape-[1] integer Tensor (or a
+    python int). Returns a traced/concrete scalar."""
+    a = _as_arr(i)
+    a = a.reshape(())
+    return a
+
+
+def create_array(dtype: Any = "float32",
+                 initialized_list: Optional[Sequence] = None,
+                 capacity: Optional[int] = None,
+                 element_shape: Optional[Sequence[int]] = None):
+    """Create a tensor array.
+
+    Without ``capacity`` this returns a Python list (the reference's
+    dynamic-mode contract, array.py:308). With ``capacity`` (+
+    ``element_shape``) it returns a ``StaticTensorArray`` — the
+    compiled-mode form whose reads/writes at traced indices stay inside
+    one XLA program (static shapes; capacity is the TPU-native analog
+    of the reference's resizable LOD_TENSOR_ARRAY).
+    """
+    init = list(initialized_list) if initialized_list is not None else []
+    for v in init:
+        if not isinstance(v, Tensor):
+            raise TypeError(
+                "All values in `initialized_list` should be Tensor, "
+                f"but received {type(v)}.")
+    if capacity is None:
+        return init
+    if element_shape is None:
+        if not init:
+            raise ValueError(
+                "create_array(capacity=...) needs element_shape when "
+                "initialized_list is empty")
+        element_shape = tuple(init[0].shape)
+    jdt = dtype_mod.jax_dtype(dtype_mod.convert_dtype(dtype))
+    stack = jnp.zeros((int(capacity),) + tuple(int(s) for s in
+                                               element_shape), jdt)
+    n = len(init)
+    if n > capacity:
+        raise ValueError(f"initialized_list ({n}) exceeds capacity "
+                         f"({capacity})")
+    for j, v in enumerate(init):
+        stack = stack.at[j].set(v._data.astype(jdt))
+    return StaticTensorArray(
+        Tensor._wrap(stack, True),
+        Tensor._wrap(jnp.asarray(n, dtype_mod.jax_dtype("int64")), True))
+
+
+def array_length(array):
+    """Length of the array as a 0-D int64 Tensor (array.py:43)."""
+    if isinstance(array, StaticTensorArray):
+        return array._length
+    return Tensor._wrap(
+        jnp.asarray(len(array), dtype_mod.jax_dtype("int64")), True)
+
+
+def array_read(array, i):
+    """Read the element at position ``i`` (array.py:110)."""
+    idx = _index(i)
+    if isinstance(array, StaticTensorArray):
+        out = lax.dynamic_index_in_dim(array._stack._data,
+                                       idx.astype(jnp.int32), 0,
+                                       keepdims=False)
+        return Tensor._wrap(out, True)
+    if isinstance(idx, jax.core.Tracer):
+        raise TypeError(
+            "array_read with a traced index needs a fixed-capacity "
+            "array: build it with create_array(dtype, capacity=N, "
+            "element_shape=[...]) so the read compiles to a "
+            "dynamic_slice")
+    return array[int(idx)]
+
+
+def array_write(x, i, array=None):
+    """Write ``x`` at position ``i``; returns the array (array.py:206).
+    ``i == length`` appends (list mode grows; static mode advances the
+    length counter — writing past capacity is an error where checkable)."""
+    if not isinstance(x, Tensor):
+        x = Tensor._wrap(jnp.asarray(_as_arr(x)), True)
+    idx = _index(i)
+    if array is None:
+        array = []
+    if isinstance(array, StaticTensorArray):
+        cap = array.capacity
+        length = array._length._data
+        if not isinstance(idx, jax.core.Tracer):
+            if int(idx) >= cap:
+                raise IndexError(
+                    f"array_write at {int(idx)} exceeds capacity {cap}")
+            # keep the list-mode contract where checkable: a concrete
+            # write past the current length would leave zero-filled
+            # slots silently counted as valid
+            if not isinstance(length, jax.core.Tracer) and \
+                    int(idx) > int(length):
+                raise IndexError(
+                    f"array_write index {int(idx)} is greater than the "
+                    f"array length {int(length)}")
+        stack = lax.dynamic_update_index_in_dim(
+            array._stack._data, x._data.astype(array._stack._data.dtype),
+            idx.astype(jnp.int32), 0)
+        new_len = jnp.maximum(
+            array._length._data,
+            idx.astype(dtype_mod.jax_dtype("int64")) + 1)
+        return StaticTensorArray(Tensor._wrap(stack, True),
+                                 Tensor._wrap(new_len, True))
+    if isinstance(idx, jax.core.Tracer):
+        raise TypeError(
+            "array_write with a traced index needs a fixed-capacity "
+            "array: build it with create_array(dtype, capacity=N, "
+            "element_shape=[...]) so the write compiles to a "
+            "dynamic_update_slice")
+    ii = int(idx)
+    if ii > len(array):
+        raise IndexError(
+            f"array_write index {ii} is greater than the array length "
+            f"{len(array)}")
+    if ii == len(array):
+        array.append(x)
+    else:
+        array[ii] = x
+    return array

@@ -2,6 +2,10 @@
 re-export of the op library, so `paddle.tensor.math.add` style imports
 work."""
 from paddle_tpu.ops import math, creation, manipulation, logic, search  # noqa: F401
+from paddle_tpu.ops import array  # noqa: F401
+from paddle_tpu.ops.array import (  # noqa: F401
+    array_length, array_read, array_write, create_array,
+    StaticTensorArray)
 from paddle_tpu.ops import linalg, random, extra, compat  # noqa: F401
 from paddle_tpu.ops.math import *  # noqa: F401,F403
 from paddle_tpu.ops.creation import *  # noqa: F401,F403

@@ -1,0 +1,107 @@
+"""Tensor-array API (reference python/paddle/tensor/array.py:43,110,
+206,308): list semantics in eager mode, fixed-capacity
+StaticTensorArray lowering (dynamic_update_slice-backed) under traces,
+and a dy2static while-loop accumulating into an array."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_eager_list_semantics_match_reference():
+    arr = paddle.tensor.create_array(dtype="float32")
+    assert arr == []
+    x = paddle.full([1, 3], 5, dtype="float32")
+    i = paddle.zeros([1], dtype="int32")
+    arr = paddle.tensor.array_write(x, i, array=arr)
+    item = paddle.tensor.array_read(arr, i)
+    np.testing.assert_array_equal(item.numpy(), np.full((1, 3), 5.0))
+    n = paddle.tensor.array_length(arr)
+    assert n.shape == [] and int(n) == 1
+    # i == len appends; i < len overwrites; i > len raises
+    arr = paddle.tensor.array_write(x * 2, paddle.to_tensor([1]), arr)
+    assert int(paddle.tensor.array_length(arr)) == 2
+    arr = paddle.tensor.array_write(x * 3, paddle.to_tensor([0]), arr)
+    np.testing.assert_array_equal(
+        paddle.tensor.array_read(arr, paddle.to_tensor([0])).numpy(),
+        np.full((1, 3), 15.0))
+    with pytest.raises(IndexError):
+        paddle.tensor.array_write(x, paddle.to_tensor([5]), arr)
+
+
+def test_array_write_creates_array_when_none():
+    x = paddle.ones([2])
+    arr = paddle.tensor.array_write(x, paddle.zeros([1], "int64"))
+    assert isinstance(arr, list) and len(arr) == 1
+
+
+def test_initialized_list():
+    x = paddle.ones([2, 2])
+    arr = paddle.tensor.create_array("float32", initialized_list=[x])
+    assert int(paddle.tensor.array_length(arr)) == 1
+    with pytest.raises(TypeError):
+        paddle.tensor.create_array("float32", initialized_list=[1.0])
+
+
+def test_static_array_read_write_parity_with_list():
+    xs = [paddle.to_tensor(np.random.RandomState(s).randn(3)
+                           .astype("float32")) for s in range(4)]
+    lst = paddle.tensor.create_array("float32")
+    sta = paddle.tensor.create_array("float32", capacity=8,
+                                     element_shape=[3])
+    for j, x in enumerate(xs):
+        i = paddle.to_tensor([j])
+        lst = paddle.tensor.array_write(x, i, lst)
+        sta = paddle.tensor.array_write(x, i, sta)
+    assert int(paddle.tensor.array_length(sta)) == 4
+    for j in range(4):
+        i = paddle.to_tensor([j])
+        np.testing.assert_array_equal(
+            paddle.tensor.array_read(lst, i).numpy(),
+            paddle.tensor.array_read(sta, i).numpy())
+
+
+def test_traced_index_on_list_raises_with_guidance():
+    lst = [paddle.ones([2])]
+
+    def f(i):
+        return paddle.tensor.array_read(lst, i)
+
+    st = paddle.jit.to_static(f)
+    with pytest.raises(TypeError, match="capacity"):
+        st(paddle.to_tensor([0]))
+
+
+def test_dy2static_while_loop_accumulates_into_array():
+    """The dy2static while-loop-carried-array case the reference routes
+    through LOD_TENSOR_ARRAY: cumulative sums collected into a
+    fixed-capacity array inside ONE compiled program."""
+    def fn(x, n_steps):
+        arr = paddle.tensor.create_array("float32", capacity=8,
+                                         element_shape=[3])
+        i = paddle.zeros([], "int64")
+        total = paddle.zeros([3], "float32")
+
+        def cond(i, total, arr):
+            return i < n_steps
+
+        def body(i, total, arr):
+            total = total + x
+            arr = paddle.tensor.array_write(total, i, arr)
+            return i + 1, total, arr
+
+        i, total, arr = paddle.static.nn.while_loop(
+            cond, body, [i, total, arr])
+        return paddle.tensor.array_read(arr, n_steps - 1), \
+            paddle.tensor.array_length(arr)
+
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    st = paddle.jit.to_static(fn)
+    last, n = st(x, paddle.to_tensor(4, "int64"))
+    np.testing.assert_allclose(last.numpy(), [4.0, 8.0, 12.0])
+    assert int(n) == 4
+    # a different trip count reuses the SAME executable (the count is
+    # an operand of the while_loop, not a shape)
+    last2, n2 = st(x, paddle.to_tensor(6, "int64"))
+    np.testing.assert_allclose(last2.numpy(), [6.0, 12.0, 18.0])
+    assert int(n2) == 6
