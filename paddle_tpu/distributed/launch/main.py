@@ -44,6 +44,14 @@ def _parse():
                         "ranks/endpoints instead of the original np; "
                         "workers resume from their distributed "
                         "checkpoint at the new world size")
+    p.add_argument("--elastic_store", default=None, metavar="DIR",
+                   help="FileKVStore root watched for scale-OUT join "
+                        "announcements (the etcd membership dir of the "
+                        "reference ElasticManager): a prospective worker "
+                        "puts join/<name>; the launcher restarts the job "
+                        "at min(MAX, current+joins), and workers resume "
+                        "from the distributed checkpoint at the larger "
+                        "world size")
     p.add_argument("script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p.parse_args()
@@ -96,6 +104,11 @@ def main():
         if not (1 <= lo <= hi):
             raise SystemExit(
                 f"--np_range needs 1 <= MIN <= MAX, got {args.np_range!r}")
+    else:
+        lo = hi = None
+    if args.elastic_store and not args.np_range:
+        raise SystemExit("--elastic_store requires --np_range (the join "
+                         "watcher needs a MAX world size to scale to)")
     if args.master is None and args.nnodes == 1:
         # single-host default: an OS-assigned ephemeral port, so
         # concurrent jobs on one machine (e.g. parallel test runs)
@@ -103,8 +116,15 @@ def main():
         # remains between releasing the probe socket and the rank-0
         # coordinator binding it.
         args.master = f"127.0.0.1:{_free_port()}"
-    attempt = 0
+    attempt = 0            # spawn generation (feeds PADDLE_RESTART_COUNT)
+    restarts = 0           # FAILURE relaunches only (gated by
+                           # --max_restarts; deliberate scale-out
+                           # restarts don't consume the failure budget)
     cur_np = args.nproc_per_node
+    store = None
+    if args.elastic_store:
+        from paddle_tpu.distributed.elastic import FileKVStore
+        store = FileKVStore(args.elastic_store)
     procs = _spawn(args, attempt)
     code = 0
 
@@ -123,6 +143,28 @@ def main():
     signal.signal(signal.SIGTERM, lambda *_: (_kill_all(), sys.exit(143)))
     try:
         while procs:
+            if store is not None:
+                joins = store.get_prefix("join/")
+                if joins and cur_np >= hi:
+                    # at MAX already: consume the announcements anyway —
+                    # left in the store they'd fire a phantom scale-out
+                    # right after a later scale-in relaunch
+                    for key in joins:
+                        store.delete(key)
+                    print(f"[launch] ignoring {len(joins)} join(s): "
+                          f"already at max world size {hi}",
+                          file=sys.stderr)
+                elif joins:
+                    new_np = min(hi, cur_np + len(joins))
+                    print(f"[launch] scaling {cur_np} -> {new_np} "
+                          "workers (join)", file=sys.stderr)
+                    _kill_all()
+                    for key in joins:
+                        store.delete(key)
+                    attempt += 1
+                    cur_np = new_np
+                    procs = _spawn(args, attempt, nprocs=cur_np)
+                    continue
             alive = []
             failed = None
             for rank, p in procs:
@@ -137,12 +179,11 @@ def main():
                 # surviving workers BEFORE teardown (scale-in basis)
                 n_alive = sum(1 for _, p in procs if p.poll() is None)
                 _kill_all()
-                if attempt < args.max_restarts:
+                if restarts < args.max_restarts:
+                    restarts += 1
                     attempt += 1
                     next_np = cur_np
                     if args.np_range:
-                        lo, hi = (int(v) for v in
-                                  args.np_range.split(":"))
                         # ElasticManager scale-in: continue at the
                         # surviving count, clamped to [lo, hi]
                         next_np = max(lo, min(hi, max(n_alive, lo)))
@@ -150,7 +191,7 @@ def main():
                             print(f"[launch] scaling {cur_np} -> "
                                   f"{next_np} workers", file=sys.stderr)
                     print(f"[launch] worker {rank} exited with {ret}; "
-                          f"relaunching job (attempt {attempt}/"
+                          f"relaunching job (attempt {restarts}/"
                           f"{args.max_restarts})", file=sys.stderr)
                     cur_np = next_np
                     procs = _spawn(args, attempt, nprocs=cur_np)

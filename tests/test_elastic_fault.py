@@ -259,3 +259,143 @@ if rank == 0:
                               oracle.named_parameters()):
         np.testing.assert_allclose(a.numpy(), b.numpy(), rtol=1e-6,
                                    atol=1e-6)
+
+
+def test_elastic_scale_out_resumes_from_checkpoint(tmp_path):
+    """End-to-end elastic scale-OUT (the mirror of the scale-in e2e;
+    reference ElasticManager manager.py:125 handles both directions):
+    2 workers train; a third announces itself through the elastic
+    store's join/ prefix; the launcher restarts the job at n=3; workers
+    resume from the distributed checkpoint and the final params match
+    an uninterrupted oracle run exactly."""
+    import os
+    import subprocess
+    import sys
+    import json as _json
+
+    from paddle_tpu.distributed.elastic import FileKVStore
+
+    ck = tmp_path / "ckpt"
+    ck.mkdir()
+    store_dir = tmp_path / "store"
+    script = tmp_path / "elastic_out_train.py"
+    script.write_text("""
+import json, os, sys, time
+sys.path.insert(0, "/root/repo")
+from paddle_tpu._testing import force_cpu
+force_cpu(1)
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import checkpoint as dc
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+attempt = int(os.environ["PADDLE_RESTART_COUNT"])
+CK = os.environ["CKPT_DIR"]
+TOTAL = 8
+open(os.path.join(CK, f"world.{attempt}.{rank}.{world}"), "w").close()
+
+paddle.seed(0)
+m = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+opt = paddle.optimizer.SGD(0.1, parameters=m.parameters())
+x = paddle.to_tensor(np.random.RandomState(0).randn(16, 4).astype("f4"))
+y = paddle.to_tensor(np.random.RandomState(1).randn(16, 2).astype("f4"))
+loss_fn = nn.MSELoss()
+
+state = {"model": m.state_dict(), "step": -1}
+start = 0
+if os.path.exists(os.path.join(CK, "metadata.json")):
+    dc.load_state_dict(state, CK)
+    start = state["step"] + 1
+
+def barrier(step):
+    open(os.path.join(CK, f"sync.{attempt}.{step}.{rank}"), "w").close()
+    while not all(os.path.exists(os.path.join(
+            CK, f"sync.{attempt}.{step}.{r}")) for r in range(world)):
+        time.sleep(0.02)
+
+for step in range(start, TOTAL):
+    barrier(step)
+    loss = loss_fn(m(x), y)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    if rank == 0:
+        dc.save_state_dict({"model": m.state_dict(), "step": step}, CK)
+    if attempt == 0:
+        # attempt 0 paces itself so the join lands mid-run (the
+        # launcher's SIGTERM interrupts this sleep)
+        time.sleep(0.3)
+if rank == 0:
+    with open(os.path.join(CK, "final_loss"), "w") as f:
+        f.write(str(float(loss)))
+""")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo"
+    env["CKPT_DIR"] = str(ck)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--max_restarts", "0",
+         "--np_range", "2:3", "--elastic_store", str(store_dir),
+         str(script)],
+        env=env, stderr=subprocess.PIPE)
+    try:
+        # wait for training to make some checkpointed progress...
+        deadline = time.time() + 120
+        meta = ck / "metadata.json"
+
+        def ck_step():
+            try:
+                return _json.loads(meta.read_text())[
+                    "tensors"]["step"]["value"]
+            except Exception:
+                return -1
+        while time.time() < deadline and ck_step() < 2:
+            time.sleep(0.1)
+        assert ck_step() >= 2, "attempt 0 never reached step 2"
+        # ...then a new worker announces itself
+        FileKVStore(str(store_dir)).put("join/worker-new", "1")
+        _, err = proc.communicate(timeout=180)
+    except Exception:
+        proc.kill()
+        raise
+    assert proc.returncode == 0, (proc.returncode, err[-800:])
+    assert b"scaling 2 -> 3 workers (join)" in err
+
+    # attempt 0 ran at world 2; attempt 1 at world 3, all three ranks
+    seen = sorted(p.name for p in ck.glob("world.*"))
+    assert "world.0.0.2" in seen and "world.0.1.2" in seen, seen
+    for r in range(3):
+        assert f"world.1.{r}.3" in seen, seen
+
+    # resumed training completed and matches the uninterrupted oracle
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed import checkpoint as dc
+    paddle.seed(0)
+    oracle = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    opt = paddle.optimizer.SGD(0.1, parameters=oracle.parameters())
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(16, 4).astype("f4"))
+    y = paddle.to_tensor(
+        np.random.RandomState(1).randn(16, 2).astype("f4"))
+    loss_fn = nn.MSELoss()
+    for _ in range(8):
+        loss = loss_fn(oracle(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    final_loss = float((ck / "final_loss").read_text())
+    assert abs(final_loss - float(loss)) < 1e-5, (final_loss,
+                                                  float(loss))
+    paddle.seed(0)
+    fresh = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    state = {"model": fresh.state_dict(), "step": -1}
+    dc.load_state_dict(state, ck)
+    assert state["step"] == 7
+    for (_, a), (_, b) in zip(fresh.named_parameters(),
+                              oracle.named_parameters()):
+        np.testing.assert_allclose(a.numpy(), b.numpy(), rtol=1e-6,
+                                   atol=1e-6)
