@@ -1,0 +1,93 @@
+"""Round-4 probe: split-program gradient accumulation on the real chip.
+
+MODE=classic : the k=1 flagship step (the bench's first rung), timed.
+MODE=split   : build_accum_steps engine — k grad_step calls (acc
+               donated) + one whole-tree apply_step per window. Programs
+               stay bench-sized (the fused k-chunk scan 500s the tunnel
+               compile helper — 3 strikes over rounds 3-4).
+
+Run each mode in its OWN process (failed-probe locals pin HBM).
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.models import gpt_hybrid as GH
+
+    mode = os.environ.get("MODE", "classic")
+    k = int(os.environ.get("K", "4"))
+    windows = int(os.environ.get("WINDOWS", "3"))
+    cfg = GPTConfig(vocab_size=50304, hidden_size=2048, num_layers=24,
+                    num_heads=16, max_seq_len=1024)
+    batch, seq = 4, 1024
+    pcfg = GH.ParallelConfig(dp=1, pp=1, tp=1, remat=True,
+                             remat_policy="names", scan_unroll=1,
+                             param_dtype=jnp.bfloat16,
+                             compute_dtype=jnp.bfloat16)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+
+    if mode == "classic":
+        mesh, params, opt_state, step = GH.setup(
+            cfg, pcfg, seed=0, devices=jax.devices()[:1])
+        with mesh:
+            for _ in range(2):
+                params, opt_state, loss = step(params, opt_state,
+                                               (ids, ids))
+            float(loss)
+            for w in range(windows):
+                t0 = time.perf_counter()
+                for _ in range(8):
+                    params, opt_state, loss = step(params, opt_state,
+                                                   (ids, ids))
+                float(loss)
+                dt = time.perf_counter() - t0
+                print(f"classic w{w}: {dt/8*1e3:.1f} ms/step "
+                      f"{batch*seq*8/dt:.0f} tok/s", flush=True)
+        return
+
+    # split engine
+    mesh = GH.build_mesh(pcfg, jax.devices()[:1])
+    with mesh:
+        params = GH.init_params(cfg, pcfg, jax.random.PRNGKey(0))
+        params, specs = GH.shard_params(params, mesh, cfg, pcfg)
+        mspecs = GH.moment_specs(params, pcfg, specs)
+        opt_state = GH.adamw_init(params, pcfg, mesh, specs,
+                                  mspecs=mspecs)
+        grad_step, apply_step = GH.build_accum_steps(
+            cfg, pcfg, mesh, state_specs=(specs, mspecs))
+        acc = GH.init_grad_accum(params)
+        # warmup: one full window (compiles both programs)
+        for i in range(k):
+            acc, loss = grad_step(params, acc, (ids, ids))
+            float(loss)
+            print(f"warmup grad_step {i} ok", flush=True)
+        params, opt_state, acc = apply_step(params, opt_state, acc, k)
+        jax.tree_util.tree_leaves(params)[0].block_until_ready()
+        float(loss)
+        print("warmup apply_step ok", flush=True)
+        for w in range(windows):
+            t0 = time.perf_counter()
+            for _ in range(2):          # 2 outer windows = 2k microbatches
+                for _ in range(k):
+                    acc, loss = grad_step(params, acc, (ids, ids))
+                params, opt_state, acc = apply_step(params, opt_state,
+                                                    acc, k)
+            float(loss)
+            dt = time.perf_counter() - t0
+            n_mb = 2 * k
+            print(f"split k={k} w{w}: {dt/n_mb*1e3:.1f} ms/microbatch "
+                  f"{batch*seq*n_mb/dt:.0f} tok/s loss={float(loss):.4f}",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()
