@@ -35,7 +35,9 @@ def main():
             if jnp.issubdtype(p._data.dtype, jnp.floating):
                 p._assign_array(p._data.astype(jnp.bfloat16))
 
-    sess = DecodeSession(m, cap)
+    import os
+    blk = int(os.environ.get("DECODE_BLOCK", "0")) or None
+    sess = DecodeSession(m, cap, decode_block=blk)
     ids = paddle.randint(0, cfg.vocab_size, [B, S])
 
     t0 = time.perf_counter()
@@ -49,7 +51,7 @@ def main():
     dt = time.perf_counter() - t0
 
     n_tok = B * new
-    print(f"model={size} B={B} S={S} new={new} cap={cap}")
+    print(f"model={size} B={B} S={S} new={new} cap={cap} block={blk}")
     print(f"warmup(compile): {warm:.2f}s")
     print(f"generate: {dt*1e3:.1f}ms  "
           f"{n_tok/dt:.1f} tok/s  {dt/new*1e3:.2f} ms/step")

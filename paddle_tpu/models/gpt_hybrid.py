@@ -618,6 +618,11 @@ def _train_grads_1f1b(params, batch, cfg, pcfg, mesh):
             return pipeline_train_interleaved(
                 stage_fn, blocks, mb, last_grad,
                 head_params=head_params, num_chunks=pcfg.vpp_chunks)
+        if pcfg.pp_schedule == "zbh1":
+            from paddle_tpu.parallel.pipeline_1f1b import \
+                pipeline_train_zbh1
+            return pipeline_train_zbh1(stage_fn, blocks, mb, last_grad,
+                                       head_params=head_params)
         return pipeline_train_1f1b(stage_fn, blocks, mb, last_grad,
                                    head_params=head_params)
 
@@ -639,18 +644,36 @@ def _train_grads_1f1b(params, batch, cfg, pcfg, mesh):
     return loss, grads
 
 
-def build_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
-                     lr=3e-4, state_specs=None):
-    if pcfg.pp_schedule not in ("gpipe", "1f1b"):
+def _validate_pp_schedule(pcfg):
+    """Shared pp-schedule validation for every engine builder (fused
+    train step, split accum engines) — the deadlock/compat guards must
+    not depend on which builder dispatches the pipeline."""
+    if pcfg.pp_schedule not in ("gpipe", "1f1b", "zbh1"):
         raise ValueError(
-            f"pp_schedule must be 'gpipe' or '1f1b', got "
+            f"pp_schedule must be 'gpipe', '1f1b' or 'zbh1', got "
             f"{pcfg.pp_schedule!r}")
     if pcfg.vpp_chunks > 1 and (pcfg.pp <= 1
                                 or pcfg.pp_schedule != "1f1b"):
         raise ValueError(
             "vpp_chunks > 1 requires pp > 1 with pp_schedule='1f1b' "
             "(the interleaved schedule generalizes the compiled 1F1B)")
-    if pcfg.pp > 1 and pcfg.pp_schedule == "1f1b":
+    if pcfg.pp_schedule == "zbh1" and (
+            pcfg.tp > 1 or (pcfg.num_experts > 0 and pcfg.dp > 1)):
+        raise ValueError(
+            "pp_schedule='zbh1' requires a collective-free stage body "
+            "(tp=1, no expert-parallel MoE): the zero-bubble phases are "
+            "cond-gated per pipeline stage, and GSPMD-inserted tp/ep "
+            "collectives inside a cond branch deadlock the mesh (half "
+            "the devices wait inside the branch's collective, half at "
+            "the next ring permute). dp composes fine — its gradient "
+            "psum sits outside the gated region. Use '1f1b' for "
+            "tp/ep hybrids.")
+
+
+def build_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
+                     lr=3e-4, state_specs=None):
+    _validate_pp_schedule(pcfg)
+    if pcfg.pp > 1 and pcfg.pp_schedule in ("1f1b", "zbh1"):
         def grads_of(params, batch):
             return _train_grads_1f1b(params, batch, cfg, pcfg, mesh)
     else:
@@ -709,10 +732,24 @@ def build_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
 
 def _make_grad_acc(cfg, pcfg, mesh):
     """One home for the accumulate-into-tree gradient step shared by
-    the accumulation engines (parity by construction)."""
+    the accumulation engines (parity by construction). Under pp>1 the
+    per-chunk gradient comes from the compiled 1F1B ring — the same
+    grads_of the fused train step uses, so gradient merge composes
+    with pipeline identically in both engines (reference:
+    auto_parallel_gradient_merge composing with the pipeline passes)."""
+    _validate_pp_schedule(pcfg)
+    if pcfg.pp > 1 and pcfg.pp_schedule in ("1f1b", "zbh1"):
+        def grads_of(params, batch):
+            return _train_grads_1f1b(params, batch, cfg, pcfg, mesh)
+    else:
+        # pp>1 + gpipe rides loss_fn's pipeline_apply forward (GPipe
+        # activation liveness — fine for small configs)
+        def grads_of(params, batch):
+            return jax.value_and_grad(
+                lambda p: loss_fn(p, batch, cfg, pcfg, mesh))(params)
+
     def grad_acc(params, acc, batch):
-        loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(p, batch, cfg, pcfg, mesh))(params)
+        loss, grads = grads_of(params, batch)
         acc = jax.tree_util.tree_map(
             lambda a, g: a + g.astype(a.dtype), acc, grads)
         return acc, loss
@@ -728,10 +765,10 @@ def build_accum_steps(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
     `apply_step(params, opt_state, acc, k) -> (params', opt_state',
     zeroed acc)` pays the bandwidth-bound AdamW update once per k
     chunks. Each program's HLO stays bench-sized, which matters on
-    toolchains that choke on the k-times-larger fused-merge program."""
-    if pcfg.pp > 1:
-        raise NotImplementedError("accum steps: pp=1 engines only")
-
+    toolchains that choke on the k-times-larger fused-merge program.
+    Under pp>1+1f1b each chunk's gradient runs the compiled pipeline
+    ring (see _make_grad_acc), so gradient merge composes with pp in
+    the split engine exactly as in the fused one."""
     grad_step = _make_grad_acc(cfg, pcfg, mesh)
 
     def apply_step(params, opt_state, acc, k):
@@ -790,12 +827,7 @@ def build_leaf_accum_bench(cfg: GPTConfig, pcfg: ParallelConfig,
     adamw_update; k=1 reproduces the classic step exactly, see
     benchmarks/_r3_flat_parity.py).
     """
-    def grad_acc(params, acc, batch):
-        loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(p, batch, cfg, pcfg, mesh))(params)
-        acc = jax.tree_util.tree_map(
-            lambda a, g: a + g.astype(a.dtype), acc, grads)
-        return acc, loss
+    grad_acc = _make_grad_acc(cfg, pcfg, mesh)
 
     def apply_leaf(p, m, v, g, step, k):
         return _adamw_leaf(p, m, v, g / k, step, lr)

@@ -40,6 +40,38 @@ from jax import lax
 from paddle_tpu.parallel.pp_schedule import PipeOp, Schedule
 
 
+def _varying_cast(axis_name: str, x):
+    """Idempotent cast-to-varying over `axis_name` (lax.cond branches and
+    scan carries must agree on the varying-manual-axes type; zeros
+    literals start unvarying)."""
+    def one(a):
+        vma = getattr(jax.typeof(a), "vma", frozenset())
+        return a if axis_name in vma else lax.pcast(
+            a, (axis_name,), to="varying")
+    return jax.tree_util.tree_map(one, x)
+
+
+def _pipeline_epilogue(axis_name, s, n, loss, head, dx0_buf, grads,
+                       grad_dtype, dtype):
+    """Shared final psums of every compiled pipeline variant: loss and
+    head grads live on the last stage, dx0 on stage 0 — psum replicates
+    them (masked elsewhere-zero). The dx0 psum runs in f32: a bf16 dx0
+    all-reduce gets combined with the f32 grad all-reduces into one
+    variadic op, and XLA:CPU's AllReducePromotion pass CHECK-crashes
+    cloning a mixed-dtype variadic all-reduce (TPU is unaffected)."""
+    loss = lax.psum(jnp.where(s == n - 1, loss, 0.0), axis_name)
+    if head is not None:
+        head = jax.tree_util.tree_map(
+            lambda g: lax.psum(jnp.where(s == n - 1, g,
+                                         jnp.zeros_like(g)), axis_name),
+            head)
+    dx0 = lax.psum(
+        jnp.where(s == 0, dx0_buf, jnp.zeros_like(dx0_buf))
+        .astype(grad_dtype), axis_name).astype(dtype)
+    grads = jax.tree_util.tree_map(lambda g: g[None], grads)
+    return loss, grads, head, dx0
+
+
 def compiled_1f1b_schedule(n_stages: int, n_microbatches: int) -> Schedule:
     """The (stage, tick) -> op timeline this module compiles, as a
     pp_schedule.Schedule — so its dependency validity, makespan and
@@ -165,23 +197,8 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params, x_microbatches,
               _varying(jnp.zeros((), grad_dtype)), dx0_buf0)
     carry, _ = lax.scan(tick, carry0, jnp.arange(t_total))
     _, _, _, grads, head, loss, dx0_buf = carry
-    # loss and head grads live on the last stage; dx0 on stage 0 —
-    # psum replicates them everywhere (masked elsewhere-zero)
-    loss = lax.psum(jnp.where(s == n - 1, loss, 0.0), axis_name)
-    if head is not None:
-        head = jax.tree_util.tree_map(
-            lambda g: lax.psum(jnp.where(s == n - 1, g,
-                                         jnp.zeros_like(g)), axis_name),
-            head)
-    # psum in f32: a bf16 dx0 all-reduce gets combined with the f32
-    # grad all-reduces into one variadic op, and XLA:CPU's
-    # AllReducePromotion pass CHECK-crashes cloning a mixed-dtype
-    # variadic all-reduce (TPU is unaffected; uniform f32 sidesteps it)
-    dx0 = lax.psum(
-        jnp.where(s == 0, dx0_buf, jnp.zeros_like(dx0_buf))
-        .astype(grad_dtype), axis_name).astype(dtype)
-    grads = jax.tree_util.tree_map(lambda g: g[None], grads)
-    return loss, grads, head, dx0
+    return _pipeline_epilogue(axis_name, s, n, loss, head, dx0_buf,
+                              grads, grad_dtype, dtype)
 
 
 # ---------------------------------------------------------------------
@@ -357,14 +374,236 @@ def pipeline_train_interleaved(stage_fn: Callable, stage_params,
               _varying(jnp.zeros((), grad_dtype)), dx0_buf0)
     carry, _ = lax.scan(tick, carry0, jnp.arange(t_total))
     _, _, _, grads, head, loss, dx0_buf = carry
-    loss = lax.psum(jnp.where(s == n - 1, loss, 0.0), axis_name)
-    if head is not None:
-        head = jax.tree_util.tree_map(
-            lambda g: lax.psum(jnp.where(s == n - 1, g,
-                                         jnp.zeros_like(g)),
-                               axis_name), head)
-    dx0 = lax.psum(
-        jnp.where(s == 0, dx0_buf, jnp.zeros_like(dx0_buf))
-        .astype(grad_dtype), axis_name).astype(dtype)
-    grads = jax.tree_util.tree_map(lambda g: g[None], grads)
-    return loss, grads, head, dx0
+    return _pipeline_epilogue(axis_name, s, n, loss, head, dx0_buf,
+                              grads, grad_dtype, dtype)
+
+
+# ---------------------------------------------------------------------
+# Compiled zero-bubble ZBH1 — round 4
+# ---------------------------------------------------------------------
+
+def _zbh1_w_recurrence(n: int, m: int, s: int):
+    """The (static) W-firing recurrence of stage s: at tick t, with nW
+    W's already retired, fire iff pending B's exist AND (the stage's F
+    lane is idle — cooldown/drain — OR the backlog exceeds s, the ZBH1
+    'defer the first s weight-grads' policy, pp_schedule.py
+    schedule_zbh1). Yields (t, fired) until all m W's retire."""
+    nW, t = 0, 0
+    while nW < m:
+        nB = min(max(t - 2 * (n - 1) + s + 1, 0), m)
+        f_active = 0 <= t - s < m
+        pending = nB - nW
+        fired = pending > 0 and ((not f_active) or pending > s)
+        if fired:
+            nW += 1
+        yield t, fired
+        t += 1
+
+
+def zbh1_extra_ticks(n_stages: int, n_microbatches: int) -> int:
+    """Drain ticks past the 1F1B grid that the deferred W backlog
+    needs (worst on the last stage, which has no F-idle cooldown)."""
+    T = n_microbatches + 2 * (n_stages - 1)
+    extra = 0
+    for s in range(n_stages):
+        last = max(t for t, f in _zbh1_w_recurrence(
+            n_stages, n_microbatches, s) if f)
+        extra = max(extra, last + 1 - T)
+    return max(extra, 0)
+
+
+def compiled_zbh1_schedule(n_stages: int, n_microbatches: int) -> Schedule:
+    """The exact (stage, tick) -> phases timeline `pipeline_train_zbh1`
+    compiles, as a checkable Schedule (the VERDICT schedule-equivalence
+    artifact). F/B ride the compiled-1F1B grid; B is input-grad ONLY
+    (cost 2: stage-granular forward recompute + dx) and the deferred W
+    (cost 2: recompute + dW) fires per the backlog recurrence. The
+    fused compiled 1F1B's honest durations are {F:1, B:3} (recompute +
+    dx + dW); zero-bubble pays one extra recompute unit per microbatch
+    to move W off the critical path into cond-skipped idle ticks.
+
+    Reference: pipeline_zero_bubble.py:62 (ZBH1's B/W split and
+    W-fills-bubbles placement)."""
+    n, m = n_stages, n_microbatches
+    T = m + 2 * (n - 1) + zbh1_extra_ticks(n, m)
+    per_stage = []
+    for s in range(n):
+        fires = dict(_zbh1_w_recurrence(n, m, s))
+        ops = []
+        nW = 0
+        for t in range(T):
+            mf = t - s
+            if 0 <= mf < m:
+                ops.append(PipeOp("F", s, mf))
+            mb = t - 2 * (n - 1) + s
+            if 0 <= mb < m:
+                ops.append(PipeOp("B", s, mb))
+            if fires.get(t, False):
+                ops.append(PipeOp("W", s, nW))
+                nW += 1
+        per_stage.append(ops)
+    return Schedule("compiled-ZBH1", n, m, per_stage,
+                    durations={"F": 1.0, "B": 2.0, "W": 2.0})
+
+
+def pipeline_train_zbh1(stage_fn: Callable, stage_params, x_microbatches,
+                        last_stage_grad: Callable,
+                        head_params=None,
+                        axis_name: str = "pp",
+                        grad_dtype=jnp.float32):
+    """Zero-bubble ZBH1 on the compiled 1F1B ring.
+
+    Two departures from `pipeline_train_1f1b`:
+
+    1. CONDITIONAL phases. The lockstep 1F1B executes masked compute on
+       every ramp/cooldown tick — the pipeline bubble is paid as wasted
+       FLOPs. Here each phase is a `lax.cond` on a device-varying
+       predicate (legal inside shard_map: each core branches on its own
+       scalar), so inactive phases cost ~nothing and the collectives
+       stay uniform (every core reaches both ppermutes every tick).
+
+    2. SPLIT backward. B computes input-grads only (vjp wrt x — the
+       inter-stage critical path); the weight-grad W is deferred into a
+       (x, gy) stash and retired by the backlog recurrence — same tick
+       when the backlog exceeds s (steady state), every tick once the
+       F lane goes idle (cooldown), plus `zbh1_extra_ticks` drain ticks
+       after the grid (W-only, no collectives). Reference:
+       pipeline_zero_bubble.py:62. Memory premium over 1F1B: the
+       (n+1)-deep W stash — reported by the memory probe.
+
+    Same contract and return values as pipeline_train_1f1b.
+    """
+    n = lax.axis_size(axis_name)
+    s = lax.axis_index(axis_name)
+    m = x_microbatches.shape[0]
+    # static mirror of m/n for the python-level drain-tick count
+    t_total = m + 2 * (n - 1)
+    k = 2 * (n - 1) + 1
+    wk = n + 1                     # W backlog bound: s+1 <= n
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+    bwd_perm = [((i + 1) % n, i) for i in range(n)]
+
+    my_params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+
+    def _v(x):
+        return _varying_cast(axis_name, x)
+
+    head_params_v = (None if head_params is None else
+                     jax.tree_util.tree_map(_v, head_params))
+
+    x_shape = x_microbatches.shape[1:]
+    dtype = x_microbatches.dtype
+    act0 = _v(jnp.zeros(x_shape, dtype))
+    cot0 = _v(jnp.zeros(x_shape, dtype))
+    stash0 = _v(jnp.zeros((k,) + x_shape, dtype))
+    wstash_x0 = _v(jnp.zeros((wk,) + x_shape, dtype))
+    wstash_gy0 = _v(jnp.zeros((wk,) + x_shape, dtype))
+    grads0 = jax.tree_util.tree_map(
+        lambda p: _v(jnp.zeros(p.shape, grad_dtype)), my_params)
+    _, _, probe_hg = last_stage_grad(jnp.zeros(x_shape, dtype),
+                                     head_params_v,
+                                     jnp.zeros((), jnp.int32))
+    head0 = None if probe_hg is None else jax.tree_util.tree_map(
+        lambda g: _v(jnp.zeros(g.shape, grad_dtype)), probe_hg)
+    dx0_buf0 = _v(jnp.zeros((m,) + x_shape, dtype))
+
+    def w_phase(nW, grads, wstash_x, wstash_gy, fire):
+        """Retire ONE deferred weight-grad when `fire`: recompute the
+        stage forward from the stashed input under vjp wrt params and
+        accumulate dW. Identity (skipped work) otherwise."""
+        def do(g):
+            x_w = wstash_x[jnp.mod(nW, wk)]
+            gy_w = wstash_gy[jnp.mod(nW, wk)]
+            _, vjpp = jax.vjp(lambda pp: stage_fn(pp, x_w), my_params)
+            (dp,) = vjpp(gy_w)
+            return _v(jax.tree_util.tree_map(
+                lambda a, d: a + d.astype(a.dtype), g, dp))
+        grads = lax.cond(fire, do, lambda g: _v(g), grads)
+        return nW + jnp.where(fire, 1, 0), grads
+
+    def tick(carry, t):
+        (act_in, cot_in, stash, wstash_x, wstash_gy, nW, grads, head,
+         loss, dx0_buf) = carry
+        # ---------------- forward (cond-gated)
+        mf = t - s
+        f_active = (mf >= 0) & (mf < m)
+        f_act = jnp.where(s == 0, x_microbatches[jnp.clip(mf, 0, m - 1)],
+                          act_in)
+        y = lax.cond(f_active,
+                     lambda: _v(stage_fn(my_params, f_act)),
+                     lambda: _v(jnp.zeros(x_shape, dtype)))
+        stash = lax.dynamic_update_index_in_dim(
+            stash, f_act, jnp.mod(t, k), 0)
+        # ---------------- last-stage loss seed (masked adds, as 1F1B)
+        loss_mb, dy_seed, hgrads = last_stage_grad(
+            y, head_params_v, jnp.clip(mf, 0, m - 1))
+        is_last = s == n - 1
+        if head is not None:
+            hmask = is_last & f_active
+            head = jax.tree_util.tree_map(
+                lambda g, d: g + jnp.where(hmask, d.astype(g.dtype), 0),
+                head, hgrads)
+        loss = loss + jnp.where(is_last & f_active, loss_mb, 0.0)
+        # ---------------- backward dx (cond-gated, input-grad ONLY)
+        mb = t - 2 * (n - 1) + s
+        b_active = (mb >= 0) & (mb < m)
+        cot = jnp.where(is_last, dy_seed, cot_in)
+        x_b = stash[jnp.mod(t - 2 * (n - 1 - s), k)]
+
+        def b_do():
+            _, vjpx = jax.vjp(lambda xx: stage_fn(my_params, xx), x_b)
+            (dx,) = vjpx(cot.astype(y.dtype))
+            return _v(dx)
+
+        dx = lax.cond(b_active, b_do,
+                      lambda: _v(jnp.zeros(x_shape, y.dtype)))
+        # stash (x, gy) for the deferred weight-grad; slot nB mod wk
+        nB_prev = jnp.clip(t - 2 * (n - 1) + s, 0, m)  # B's before t
+        wslot = jnp.mod(nB_prev, wk)
+        wstash_x, wstash_gy = lax.cond(
+            b_active,
+            lambda wx, wg: (
+                lax.dynamic_update_index_in_dim(wx, x_b, wslot, 0),
+                lax.dynamic_update_index_in_dim(
+                    wg, cot.astype(dtype), wslot, 0)),
+            lambda wx, wg: (wx, wg), wstash_x, wstash_gy)
+        # ---------------- deferred weight-grad (backlog recurrence)
+        nB = jnp.clip(t - 2 * (n - 1) + s + 1, 0, m)
+        pending = nB - nW
+        fire = (pending > 0) & (~f_active | (pending > s))
+        nW, grads = w_phase(nW, grads, wstash_x, wstash_gy, fire)
+        # ---------------- stage-0 input cotangents
+        dx0_buf = lax.cond(
+            (s == 0) & b_active,
+            lambda buf: lax.dynamic_update_index_in_dim(
+                buf, dx.astype(dtype), jnp.clip(mb, 0, m - 1), 0),
+            lambda buf: buf, dx0_buf)
+        # ---------------- hops
+        act_out = lax.ppermute(y, axis_name, fwd_perm)
+        cot_out = lax.ppermute(dx, axis_name, bwd_perm)
+        return (act_out, cot_out, stash, wstash_x, wstash_gy, nW, grads,
+                head, loss, dx0_buf), None
+
+    carry0 = (act0, cot0, stash0, wstash_x0, wstash_gy0,
+              _v(jnp.zeros((), jnp.int32)), grads0, head0,
+              _v(jnp.zeros((), grad_dtype)), dx0_buf0)
+    carry, _ = lax.scan(tick, carry0, jnp.arange(t_total))
+    (_, _, _, wstash_x, wstash_gy, nW, grads, head, loss,
+     dx0_buf) = carry
+
+    # drain: retire the remaining W backlog, no collectives involved
+    n_extra = zbh1_extra_ticks(
+        int(n) if isinstance(n, int) else n, m)
+
+    def drain(carry, _t):
+        nW, grads = carry
+        fire = nW < m
+        nW, grads = w_phase(nW, grads, wstash_x, wstash_gy, fire)
+        return (nW, grads), None
+
+    if n_extra > 0:
+        (nW, grads), _ = lax.scan(drain, (nW, grads),
+                                  jnp.arange(n_extra))
+
+    return _pipeline_epilogue(axis_name, s, n, loss, head, dx0_buf,
+                              grads, grad_dtype, dtype)

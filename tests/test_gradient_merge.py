@@ -164,3 +164,67 @@ def test_no_sync_defers_stage2_relay():
               if p.grad is not None
               and not p.grad._data.sharding.is_fully_replicated]
     assert relaid, "stage-2 re-lay should have fired at window exit"
+
+
+def test_split_accum_composes_with_pipeline():
+    """Gradient merge under pp in the compiled engines (VERDICT r3
+    item 10): the split accum engine at pp=2 accumulates stage grads
+    across k=2 outer 1F1B rounds; its update matches the FUSED
+    gradient_merge_steps=2 run exactly (same chunks, same order) and a
+    single 2x-microbatch step closely (same math, different reduction
+    order). Reference: auto_parallel_gradient_merge.py composing with
+    the pipeline passes."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.models import gpt_hybrid as GH
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=4,
+                    num_heads=2, max_seq_len=32)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 128, (8, 32)))
+    base = dict(dp=1, pp=2, tp=1, microbatches=2, pp_schedule="1f1b",
+                remat=True)
+
+    def fresh_state(pcfg):
+        mesh = GH.build_mesh(pcfg, jax.devices()[:2])
+        with mesh:
+            params = GH.init_params(cfg, pcfg, jax.random.PRNGKey(0))
+            params, specs = GH.shard_params(params, mesh, cfg, pcfg)
+            mspecs = GH.moment_specs(params, pcfg, specs)
+            opt = GH.adamw_init(params, pcfg, mesh, specs, mspecs=mspecs)
+        return mesh, params, opt, specs, mspecs
+
+    # split engine: two half-batch 1F1B chunks + one apply
+    pcfg = GH.ParallelConfig(**base)
+    mesh, params, opt, specs, mspecs = fresh_state(pcfg)
+    grad_step, apply_step = GH.build_accum_steps(
+        cfg, pcfg, mesh, state_specs=(specs, mspecs))
+    acc = GH.init_grad_accum(params)
+    with mesh:
+        acc, _ = grad_step(params, acc, (ids[:4], ids[:4]))
+        acc, _ = grad_step(params, acc, (ids[4:], ids[4:]))
+        p_split, _o, _a = apply_step(params, opt, acc, 2)
+
+    # fused engine: gradient_merge_steps=2 over the same global batch
+    pcfg_f = GH.ParallelConfig(gradient_merge_steps=2, **base)
+    mesh_f, params_f, opt_f, _, _ = fresh_state(pcfg_f)
+    step_f = GH.build_train_step(cfg, pcfg_f, mesh_f)
+    with mesh_f:
+        p_fused, _o, _l = step_f(params_f, opt_f, (ids, ids))
+
+    for a, b in zip(jax.tree_util.tree_leaves(p_split),
+                    jax.tree_util.tree_leaves(p_fused)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)
+
+    # and a single 2x-microbatch step over the full batch (same update
+    # math, reduction order differs -> close, not bitwise)
+    pcfg_b = GH.ParallelConfig(**{**base, "microbatches": 4})
+    mesh_b, params_b, opt_b, _, _ = fresh_state(pcfg_b)
+    step_b = GH.build_train_step(cfg, pcfg_b, mesh_b)
+    with mesh_b:
+        p_big, _o, _l = step_b(params_b, opt_b, (ids, ids))
+    for a, b in zip(jax.tree_util.tree_leaves(p_split),
+                    jax.tree_util.tree_leaves(p_big)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
