@@ -87,8 +87,9 @@ class PlanCandidate:
     def collective_matmul(self) -> bool:
         """Ring-overlap knob for the sp matmuls: recommended whenever
         the plan sequence-parallelizes over a real tp axis at pp==1
-        (the supported overlap region — gpt_hybrid._use_cm). Consumed
-        by to_parallel_config()."""
+        (pp>1 remains blocked by a Shardy nesting wall, re-confirmed
+        round 4 with a canary reproducer — gpt_hybrid._use_cm).
+        Consumed by to_parallel_config()."""
         return self.sp and self.tp > 1 and self.pp == 1
 
     def to_parallel_config(self, **overrides):

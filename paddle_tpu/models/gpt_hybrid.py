@@ -77,8 +77,7 @@ class ParallelConfig:
     # .py; the reference overlaps these with CUDA streams,
     # sequence_parallel_utils.py:240-340). Opt-in: wins only when the
     # gather/scatter is bandwidth-bound on real multi-chip ICI.
-    # Applies when pp == 1 (Shardy cannot nest the tp-manual ring
-    # inside the pp-manual 1F1B region — see _use_cm)
+    # Applies at pp==1 only (Shardy nesting wall — see _use_cm)
     collective_matmul: bool = False
     zero1: bool = True        # shard adam moments over dp
     # Adam moment storage dtype. None (default) INHERITS the param
@@ -278,12 +277,18 @@ def _moe_ffn(x, lp, pcfg, mesh):
 
 
 def _use_cm(pcfg):
-    # pp>1 exclusion is a Shardy nesting limit, not a design choice: the
-    # inner tp-manual shard_map inside the pp-manual 1F1B region trips
-    # sdy's "manual axes must precede free axes" verifier on captured
-    # operands varying over (pp, tp). Ring-overlap therefore applies on
-    # pure tp/sp (+dp) configs; pp stages fall back to GSPMD constraint
-    # resharding.
+    # pp>1 exclusion RE-CONFIRMED in round 4 (not a design choice; a
+    # Shardy expressibility wall, re-probed with minimal reproducers —
+    # tests/test_collective_matmul.py::test_cm_under_pp_upstream_wall):
+    # an inner tp-manual region whose operands vary over the outer pp
+    # axis hits, depending on structure, (a) 'manual axes must come
+    # before free axes' when a rank-1 operand's vma {pp,tp} squashes
+    # both onto dim 0, (b) 'operates on axis already bound by parent'
+    # when the vma widening pcast sits inside the inner region, or
+    # (c) scan-carry vma mismatches. The canary test asserts (a) still
+    # reproduces — when a jax upgrade clears it, the test fails and
+    # this gate should be retried (the cm ring itself already handles
+    # nested-context meshes + vma unions).
     return pcfg.collective_matmul and pcfg.sp and pcfg.tp > 1 \
         and pcfg.pp == 1
 

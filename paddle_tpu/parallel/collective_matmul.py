@@ -29,20 +29,29 @@ def _fwd_perm(n):
     return [(i, (i + 1) % n) for i in range(n)]
 
 
-def _zeros_like_vma(shape, dtype, ref, axis_name):
-    """Zeros whose varying-manual-axes spec covers {axis_name} UNION
-    ref's vma: a scan carry must type-match the body output, and when
-    these primitives run nested inside another manual region (e.g. the
-    1F1B pp shard_map) the blocks inherit extra varying axes from the
-    inputs."""
-    z = jnp.zeros(shape, dtype)
+def _widen_vma(val, refs, axis_name, fallback=()):
+    """pcast `val` up to the union vma of refs + {axis_name}
+    (idempotent): a scan carry must enter at its steady-state varying
+    type, and when these primitives run nested inside another manual
+    region (e.g. the 1F1B pp shard_map) the ring carries inherit extra
+    varying axes from EITHER operand (the activation from the stage
+    input, the weight shard from the pp-stacked params). `fallback` is
+    applied when vma introspection is unavailable."""
     try:
-        want = set(jax.typeof(ref).vma) | {axis_name}
-        have = set(jax.typeof(z).vma)
+        want = {axis_name}
+        for ref in refs:
+            want |= set(jax.typeof(ref).vma)
+        have = set(jax.typeof(val).vma)
         missing = tuple(sorted(want - have))
     except Exception:
-        missing = (axis_name,)
-    return lax.pcast(z, missing, to="varying") if missing else z
+        missing = tuple(fallback)
+    return lax.pcast(val, missing, to="varying") if missing else val
+
+
+def _zeros_like_vma(shape, dtype, refs, axis_name):
+    """Zeros at the union vma of refs + {axis_name} (see _widen_vma)."""
+    return _widen_vma(jnp.zeros(shape, dtype), refs, axis_name,
+                      fallback=(axis_name,))
 
 
 def all_gather_matmul(x, w, axis_name: str):
@@ -58,8 +67,9 @@ def all_gather_matmul(x, w, axis_name: str):
     idx = lax.axis_index(axis_name)
     s = x.shape[0]
     out = _zeros_like_vma((n * s,) + x.shape[1:-1] + (w.shape[-1],),
-                          jnp.promote_types(x.dtype, w.dtype), x,
+                          jnp.promote_types(x.dtype, w.dtype), (x, w),
                           axis_name)
+    x = _widen_vma(x, (x, w), axis_name)
 
     def step(carry, i):
         x_cur, out = carry
@@ -93,7 +103,7 @@ def matmul_reduce_scatter(x, w, axis_name: str):
         raise ValueError(f"rows {m} not divisible by axis size {n}")
     s = m // n
     acc = _zeros_like_vma((s,) + x.shape[1:-1] + (w.shape[-1],),
-                          jnp.promote_types(x.dtype, w.dtype), x,
+                          jnp.promote_types(x.dtype, w.dtype), (x, w),
                           axis_name)
 
     def block_for(dest):
@@ -136,26 +146,46 @@ def sp_row_matmul_local(x_local, w_local, axis_name: str):
     return jnp.swapaxes(ot, 0, 1)
 
 
+def _nested_manual_context() -> bool:
+    """True when we're already inside a shard_map manual region (e.g.
+    the compiled 1F1B's pp region): the inner shard_map must then
+    INHERIT the context AbstractMesh (mesh=None) instead of naming the
+    concrete one — naming it raises the context-mesh mismatch, which
+    was round 3's pp>1 blocker for collective matmul."""
+    try:
+        cur = jax.sharding.get_abstract_mesh()
+        return any("Manual" in str(t)
+                   for t in getattr(cur, "axis_types", ()))
+    except Exception:
+        return False
+
+
+def _smap(fn, mesh, in_specs, out_specs, axis_name):
+    from jax import shard_map
+    if _nested_manual_context():
+        return shard_map(fn, axis_names={axis_name},
+                         in_specs=in_specs, out_specs=out_specs)
+    return shard_map(fn, mesh=mesh, axis_names={axis_name},
+                     in_specs=in_specs, out_specs=out_specs)
+
+
 def sp_column_matmul(x, w, mesh, axis_name="mp"):
     """Global-array form (eager or jit): x [B, S, K] sequence-sharded
     over `axis_name`, w [K, F] column-sharded. Ring-overlapped; output
-    [B, S, F] gathered on S, sharded on F."""
+    [B, S, F] gathered on S, sharded on F. Composes under an enclosing
+    manual region (pp) via mesh inheritance."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
-    return shard_map(
+    return _smap(
         lambda a, b: sp_column_matmul_local(a, b, axis_name),
-        mesh=mesh, axis_names={axis_name},
-        in_specs=(P(None, axis_name, None), P(None, axis_name)),
-        out_specs=P(None, None, axis_name))(x, w)
+        mesh, (P(None, axis_name, None), P(None, axis_name)),
+        P(None, None, axis_name), axis_name)(x, w)
 
 
 def sp_row_matmul(x, w, mesh, axis_name="mp"):
     """Global-array form: x [B, S, K] feature-sharded over `axis_name`,
     w [K, F] row-sharded. Output [B, S, F] sequence-sharded on S."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
-    return shard_map(
+    return _smap(
         lambda a, b: sp_row_matmul_local(a, b, axis_name),
-        mesh=mesh, axis_names={axis_name},
-        in_specs=(P(None, None, axis_name), P(axis_name, None)),
-        out_specs=P(None, axis_name, None))(x, w)
+        mesh, (P(None, None, axis_name), P(axis_name, None)),
+        P(None, axis_name, None), axis_name)(x, w)
