@@ -120,6 +120,10 @@ define_flag("FLAGS_print_jaxpr", False,
 define_flag("FLAGS_max_specializations", 8,
             "cap on cached to_static specializations per signature "
             "before eager fallback")
+define_flag("FLAGS_max_shape_specializations", 8,
+            "cap on distinct dynamic-dim (InputSpec None) shapes a "
+            "to_static fn compiles before new shapes run eagerly "
+            "(the shape-dialect surface's executable budget)")
 define_flag("FLAGS_retain_grad_for_all", False,
             "keep .grad on non-leaf tensors after backward (debugging; "
             "the retain_grads analog)")

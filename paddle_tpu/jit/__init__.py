@@ -283,14 +283,153 @@ def _float_thrash(new, old):
 
 
 class StaticFunction:
-    def __init__(self, fn, objs=None, donate_states=True, backend=None):
+    def __init__(self, fn, objs=None, donate_states=True, backend=None,
+                 input_spec=None, pad_dynamic_dims=False):
         self._fn = fn
         self._objs = objs
         self._donate = donate_states
         self._cache = {}          # signature -> entry dict
         self._state: Optional[List[Tensor]] = None
+        # symbolic-shape surface (reference: PIR shape dialect /
+        # InputSpec(-1) dims, SURVEY §2.4). Dims declared None/-1 in
+        # input_spec are DYNAMIC: each concretization compiles once
+        # (exact numerics; XLA is static-shape), the set of compiled
+        # shapes is reported (report()["shape_specializations"]) and
+        # capped by FLAGS_max_shape_specializations — past the cap new
+        # shapes run eagerly instead of silently compiling forever.
+        # pad_dynamic_dims=True instead PADS every dynamic dim up to
+        # the next power-of-two bucket so ONE executable serves all
+        # sizes in a bucket — the decode-prefill bucketing discipline
+        # generalized; outputs carrying the first dynamic dim's bucket
+        # size on axis 0 are sliced back to the true size. Padded rows
+        # flow through the function, so this mode is for row-
+        # independent (inference-style) fns and refuses stateful
+        # train-step objs.
+        self._dyn_dims = self._parse_dynamic_dims(input_spec)
+        self._pad_dynamic = bool(pad_dynamic_dims)
+        if self._pad_dynamic and not self._dyn_dims:
+            raise ValueError(
+                "pad_dynamic_dims=True needs an input_spec with "
+                "None/-1 dims to know which axes to bucket")
+        self._shape_family = set()
+        self._shape_overflow = False
+        self._slice_plans = {}
+        if self._pad_dynamic:
+            check_objs = objs
+            if check_objs is None:
+                owner = getattr(fn, "__self__", None)
+                check_objs = [owner] if owner is not None else []
+            _, opts, scalers = _collect_objects(check_objs)
+            if opts or scalers:
+                raise ValueError(
+                    "pad_dynamic_dims pads rows through the function, "
+                    "which would corrupt stateful (optimizer/scaler) "
+                    "updates — use exact dynamic shapes "
+                    "(pad_dynamic_dims=False) for train steps")
         functools.update_wrapper(self, fn, updated=[])
         _static_functions.add(self)
+
+    @staticmethod
+    def _parse_dynamic_dims(input_spec):
+        """[(tensor_leaf_index, dim_index)] for every None/-1 dim; the
+        i-th InputSpec aligns with the i-th Tensor leaf of the call."""
+        if not input_spec:
+            return []
+        out = []
+        for li, s in enumerate(input_spec):
+            shape = getattr(s, "shape", None)
+            if shape is None:
+                continue
+            for di, d in enumerate(shape):
+                if d in (-1, None):
+                    out.append((li, di))
+        return out
+
+    @staticmethod
+    def _bucket(n):
+        n = int(n)
+        return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+    def _dyn_sizes(self, arg_arrays):
+        """Concrete sizes of the declared dynamic dims, with a clear
+        error when the call's rank disagrees with the InputSpec."""
+        out = []
+        for li, di in self._dyn_dims:
+            if li >= len(arg_arrays):
+                continue
+            a = arg_arrays[li]
+            if di >= a.ndim:
+                raise ValueError(
+                    f"input_spec declares dynamic dim {di} on tensor "
+                    f"argument {li}, but the call passed a rank-"
+                    f"{a.ndim} tensor of shape {tuple(a.shape)}")
+            out.append((li, di, int(a.shape[di])))
+        return out
+
+    def _pad_args(self, arg_arrays):
+        """Pad every dynamic dim to its power-of-two bucket; returns
+        (padded arrays, (true size, padded size) of the first dynamic
+        dim)."""
+        arrays = list(arg_arrays)
+        first = None
+        for li, di, true in self._dyn_sizes(arg_arrays):
+            a = arrays[li]
+            pad = self._bucket(true) - true
+            if first is None:
+                first = (true, self._bucket(true))
+            if pad:
+                widths = [(0, 0)] * a.ndim
+                widths[di] = (0, pad)
+                arrays[li] = jnp.pad(a, widths)
+        return arrays, first
+
+    def _slice_plan(self, meta, unpadded_arrays, true, padded):
+        """Which output leaves actually DERIVE their axis 0 from the
+        padded dim: shape-trace the fn on the UNPADDED abstract inputs
+        (jax.eval_shape — no compute) and mark leaves whose dim 0 is
+        the true (unpadded) size. A size-equality heuristic alone would
+        also truncate batch-independent outputs that coincidentally
+        carry the bucket size on axis 0."""
+        key = (meta[0], tuple(a.shape for a in unpadded_arrays))
+        if key in self._slice_plans:
+            return self._slice_plans[key]
+
+        def shape_probe(arrays):
+            args, kwargs = _tree_unflatten_args(list(arrays), meta)
+            out = self._fn(*args, **kwargs)
+            arrs, _ = _flatten_out(out)
+            return tuple(arrs)
+
+        try:
+            abstract = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                             for a in unpadded_arrays)
+            true_out = jax.eval_shape(shape_probe, abstract)
+            plan = tuple(len(s.shape) >= 1 and s.shape[0] == true
+                         for s in true_out)
+        except Exception:
+            # untraceable fn: fall back to the dim0-size heuristic
+            plan = None
+        self._slice_plans[key] = plan
+        return plan
+
+    def _slice_outputs(self, result, true, padded, plan=None):
+        """Undo the bucket padding on outputs derived from the first
+        dynamic dim (per `plan`; dim0==padded heuristic when the fn is
+        untraceable for the shape probe)."""
+        if true == padded:
+            return result
+        leaves, treedef = jax.tree_util.tree_flatten(
+            result, is_leaf=lambda x: isinstance(x, Tensor))
+        out = []
+        for i, v in enumerate(leaves):
+            take = (plan[i] if plan is not None and i < len(plan)
+                    else (isinstance(v, Tensor) and v.ndim >= 1
+                          and v.shape[0] == padded))
+            if take and isinstance(v, Tensor) and v.ndim >= 1 and \
+                    v.shape[0] == padded:
+                v = v[:true]
+            out.append(v)
+        return jax.tree_util.tree_unflatten(treedef, out)
 
     def _resolve_state(self):
         objs = self._objs
@@ -320,7 +459,11 @@ class StaticFunction:
                 "fallback": e["fallback"],
             })
         return {"function": getattr(self._fn, "__qualname__", str(self._fn)),
-                "signatures": out}
+                "signatures": out,
+                "dynamic_dims": list(self._dyn_dims),
+                "shape_specializations": sorted(self._shape_family),
+                "shape_overflowed": self._shape_overflow,
+                "pad_dynamic_dims": self._pad_dynamic}
 
     def __call__(self, *args, **kwargs):
         state = self._resolve_state()
@@ -334,6 +477,37 @@ class StaticFunction:
             # trace: inline into the enclosing program (the outer
             # context owns the scalarization decisions)
             return self._fn(*args, **kwargs)
+        pad_slice = None
+        pad_plan = None
+        if self._dyn_dims:
+            if self._pad_dynamic:
+                unpadded = list(arg_arrays)
+                arg_arrays, pad_slice = self._pad_args(arg_arrays)
+                if pad_slice is not None and \
+                        pad_slice[0] != pad_slice[1]:
+                    pad_plan = self._slice_plan(meta, unpadded,
+                                                *pad_slice)
+                args, kwargs = _tree_unflatten_args(arg_arrays, meta)
+            else:
+                dyn_key = tuple(
+                    sz for _li, _di, sz in self._dyn_sizes(arg_arrays))
+                if dyn_key not in self._shape_family:
+                    from paddle_tpu.core.flags import get_flag as _gf
+                    cap = _gf("FLAGS_max_shape_specializations")
+                    if len(self._shape_family) >= cap:
+                        if not self._shape_overflow:
+                            import warnings
+                            warnings.warn(
+                                f"to_static: {self._fn.__qualname__} "
+                                f"saw more than {cap} distinct dynamic "
+                                "shapes (FLAGS_max_shape_"
+                                "specializations); new shapes run "
+                                "eagerly. Consider pad_dynamic_dims="
+                                "True (bucketed) for inference fns",
+                                stacklevel=2)
+                            self._shape_overflow = True
+                        return self._fn(*args, **kwargs)
+                    self._shape_family.add(dyn_key)
         sig = (meta[0], tuple(
             s if s[0] == "S" and _hashable(s) else ("T",)
             for s in meta[1]), len(state))
@@ -343,7 +517,11 @@ class StaticFunction:
                 "specs": [], "mru": 0, "breaks": 0, "probes": 0,
                 "fallback": None}
         if entry["fallback"] is not None:
-            return self._fn(*args, **kwargs)
+            result = self._fn(*args, **kwargs)
+            if pad_slice is not None:
+                result = self._slice_outputs(result, *pad_slice,
+                                             plan=pad_plan)
+            return result
 
         if not entry["specs"]:
             # optimistic first specialization: no decisions
@@ -364,7 +542,11 @@ class StaticFunction:
                 if not spec.decisions:
                     entry["specs"].pop(idx)        # invalid skeleton
                     entry["mru"] = 0
-                return self._probe(entry, meta, args, kwargs)
+                result = self._probe(entry, meta, args, kwargs)
+                if pad_slice is not None:
+                    result = self._slice_outputs(result, *pad_slice,
+                                                 plan=pad_plan)
+                return result
             except (jax.errors.TracerBoolConversionError,
                     jax.errors.ConcretizationTypeError,
                     jax.errors.TracerArrayConversionError) as e:
@@ -376,10 +558,17 @@ class StaticFunction:
                     f"traceable ({type(e).__name__}); falling back to "
                     f"eager execution", stacklevel=2)
                 entry["fallback"] = f"{type(e).__name__}: {e}"
-                return self._fn(*args, **kwargs)
+                result = self._fn(*args, **kwargs)
+                if pad_slice is not None:
+                    result = self._slice_outputs(result, *pad_slice,
+                                                 plan=pad_plan)
+                return result
             if ok:
                 spec.hits += 1
                 entry["mru"] = idx
+                if pad_slice is not None:
+                    result = self._slice_outputs(result, *pad_slice,
+                                                 plan=pad_plan)
                 return result
             # guard mismatch: another cached specialization whose
             # decisions agree with the observed predicate values can
@@ -392,7 +581,11 @@ class StaticFunction:
                     break
             if nxt is None:
                 entry["breaks"] += 1
-                return self._probe(entry, meta, args, kwargs)
+                result = self._probe(entry, meta, args, kwargs)
+                if pad_slice is not None:
+                    result = self._slice_outputs(result, *pad_slice,
+                                                 plan=pad_plan)
+                return result
             idx = nxt
 
     def _run_spec(self, spec, state, gen, arg_arrays):
@@ -564,14 +757,20 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     compiled program threads through (auto-detected for bound Layer
     methods). Compile a whole train step by passing [model, optimizer].
     """
+    pad_dynamic_dims = kwargs.pop("pad_dynamic_dims", False)
+
     def decorate(fn):
         from paddle_tpu.nn.layer.layers import Layer
         if isinstance(fn, Layer):
             sf = StaticFunction(fn.forward, objs=[fn] + list(objs or ()),
-                                donate_states=donate)
+                                donate_states=donate,
+                                input_spec=input_spec,
+                                pad_dynamic_dims=pad_dynamic_dims)
             fn.forward = sf
             return fn
-        return StaticFunction(fn, objs=objs, donate_states=donate)
+        return StaticFunction(fn, objs=objs, donate_states=donate,
+                              input_spec=input_spec,
+                              pad_dynamic_dims=pad_dynamic_dims)
     if function is not None:
         return decorate(function)
     return decorate
