@@ -163,11 +163,17 @@ class PipelinePartition:
                 else:
                     b.__dict__["forward"] = fwd
 
-    def prologue(self, x: Tensor) -> Tensor:
+    def prologue(self, x: Tensor):
         """Everything the model computes before block 0, extracted by
-        capture-aborting at block 0's entry."""
+        capture-aborting at block 0's entry. Returns (block0_input,
+        extra_args, extra_kwargs) — models whose blocks take extra
+        arguments (attention masks, position ids: the reference
+        PipelineLayer's tuple-valued stage IO, pp_layers.py:56) have
+        those captured too; Tensor extras become per-microbatch
+        NON-differentiated side inputs of every stage, non-Tensor
+        extras stay static."""
         def capture(inp, *a, **k):
-            raise _BlockCapture(inp)
+            raise _BlockCapture((inp, a, k))
         try:
             self._run_with_shims({self.blocks[0]: capture}, x)
         except _BlockCapture as c:
@@ -194,7 +200,8 @@ class PipelinePartition:
             return self.loss_fn(out, labels)
         return out
 
-    def run_template(self, x: Tensor, param_arrays: List) -> Tensor:
+    def run_template(self, x: Tensor, param_arrays: List,
+                     extra_args=(), extra_kwargs=None) -> Tensor:
         """One block's forward with its params rebound to given arrays
         (the scanned per-layer slices)."""
         tpl = list(self.template.named_parameters())
@@ -202,7 +209,8 @@ class PipelinePartition:
         try:
             for (_, p), a in zip(tpl, param_arrays):
                 p._data = a
-            return self.template(x)
+            return self.template(x, *extra_args,
+                                 **(extra_kwargs or {}))
         finally:
             for (_, p), s in zip(tpl, saved):
                 p._data = s
@@ -240,14 +248,78 @@ class PipelinePartition:
                 for (_, p), a in zip(other, other_arrays):
                     p._data = a
                 with paddle.no_grad():
-                    out = self.prologue(Tensor._wrap(x_arr, True))
-                return out._data
+                    h0, a_, _k = self.prologue(Tensor._wrap(x_arr,
+                                                            True))
+                sides = tuple(a_[i]._data for i in side_pos)
+                return (h0._data,) + sides
             finally:
                 for (_, p), s in zip(other, saved):
                     p._data = s
 
+        # probe the block-entry signature: record EVERY block's extra
+        # call args in one real forward (pass-through shims), so models
+        # whose blocks receive per-block-varying extras are rejected
+        # loudly instead of silently replaying block 0's values
+        records = []
+
+        def _recorder(b):
+            orig = b.forward
+
+            def fn(inp, *a, **k):
+                records.append((a, k))
+                return orig(inp, *a, **k)
+            return fn
+
+        with paddle.no_grad():
+            self._run_with_shims(
+                {b: _recorder(b) for b in self.blocks}, x)
+        if len(records) != len(self.blocks):
+            raise RuntimeError(
+                f"expected {len(self.blocks)} block calls in "
+                f"model.forward, saw {len(records)} — blocks must be "
+                "applied exactly once each")
+        probe_a, probe_k = records[0]
+        for kk, vv in probe_k.items():
+            if isinstance(vv, Tensor):
+                raise NotImplementedError(
+                    f"pipeline blocks taking Tensor KWARGS ({kk!r}) "
+                    "are not supported — pass tensor side inputs "
+                    "positionally")
+        for bi, (a_, k_) in enumerate(records[1:], 1):
+            if len(a_) != len(probe_a) or set(k_) != set(probe_k):
+                raise NotImplementedError(
+                    "pipeline blocks must share one call signature; "
+                    f"block {bi} differs from block 0")
+            for i, (v0, vi) in enumerate(zip(probe_a, a_)):
+                both_t = isinstance(v0, Tensor) and isinstance(vi,
+                                                               Tensor)
+                if both_t:
+                    # same traced object => provably the same value;
+                    # distinct objects may differ per block (rotary
+                    # caches, layer indices) which the scanned replay
+                    # cannot honor
+                    if v0 is not vi:
+                        raise NotImplementedError(
+                            f"block argument {i} varies per block "
+                            "(different tensors at block 0 and "
+                            f"{bi}); per-block-varying side inputs "
+                            "are not supported by the generic "
+                            "partitioner")
+                elif v0 is not vi and v0 != vi:
+                    raise NotImplementedError(
+                        f"static block argument {i} varies per block "
+                        f"({v0!r} at block 0, {vi!r} at block {bi}) — "
+                        "the scanned stage replays ONE value for all "
+                        "layers")
+        side_pos = [i for i, v in enumerate(probe_a)
+                    if isinstance(v, Tensor)]
+        static_args = {i: v for i, v in enumerate(probe_a)
+                       if not isinstance(v, Tensor)}
+        static_kwargs = dict(probe_k)
+
         other_arrays = [p._data for _, p in other]
-        x0, prologue_vjp = jax.vjp(prologue_fn, other_arrays, x._data)
+        (x0, *side_arrays), prologue_vjp = jax.vjp(
+            prologue_fn, other_arrays, x._data)
 
         # --- microbatch + stack blocks
         b = x0.shape[0]
@@ -260,6 +332,32 @@ class PipelinePartition:
         mb = x0.reshape((m, b // m) + x0.shape[1:])
         lbl = labels._data
         lbl_mb = lbl.reshape((m, b // m) + lbl.shape[1:])
+        # tensor extras become [M, ...] side inputs. Batch-carrying vs
+        # batch-free is decided STRUCTURALLY (an eval_shape of the
+        # prologue at a different batch size — no compute), not by the
+        # leading-dim==batch heuristic, which misfires when a shared
+        # [seq, seq] mask happens to have seq == batch
+        if side_arrays:
+            probe_b = max(1, b // m)
+            if probe_b == b:
+                probe_b = max(1, b // 2)
+            shapes_small = jax.eval_shape(
+                prologue_fn,
+                [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                 for a in other_arrays],
+                jax.ShapeDtypeStruct((probe_b,) + x._data.shape[1:],
+                                     x._data.dtype))[1:]
+            batchful = [
+                sa.ndim >= 1 and sa.shape[0] == b
+                and len(ss.shape) >= 1 and ss.shape[0] == probe_b
+                and probe_b != b
+                for sa, ss in zip(side_arrays, shapes_small)]
+        else:
+            batchful = []
+        side_mb = tuple(
+            sa.reshape((m, b // m) + sa.shape[1:]) if bf
+            else jnp.broadcast_to(sa[None], (m,) + sa.shape)
+            for sa, bf in zip(side_arrays, batchful))
 
         stacked = self.stacked_blocks()
         stacked = [
@@ -268,11 +366,20 @@ class PipelinePartition:
                 NamedSharding(mesh, P("pp", *[None] * s.ndim)))
             for s in stacked]
 
-        def stage_fn(stage_params, xm):
+        def stage_fn(stage_params, xm, side=()):
+            extra = []
+            si = iter(side)
+            for i in range(len(probe_a)):
+                if i in static_args:
+                    extra.append(static_args[i])
+                else:
+                    extra.append(Tensor._wrap(next(si), True))
+
             def body(h, lp):
                 with paddle.no_grad():
                     out = self.run_template(Tensor._wrap(h, True),
-                                            list(lp))
+                                            list(lp), tuple(extra),
+                                            static_kwargs)
                 return out._data, None
             h, _ = lax.scan(body, xm, tuple(stage_params))
             return h
@@ -305,20 +412,24 @@ class PipelinePartition:
         from jax import shard_map
         blk_specs = tuple(P("pp") for _ in stacked)
 
-        def body(stacked, mb, lbl_mb_, head_arrays):
+        def body(stacked, mb, lbl_mb_, head_arrays, side_mb_):
             return pipeline_train_1f1b(
                 stage_fn, tuple(stacked), mb,
-                last_grad, head_params=list(head_arrays))
+                last_grad, head_params=list(head_arrays),
+                side_inputs=side_mb_ if side_mb_ else None)
 
         loss, sgrads, hgrads, dx0 = shard_map(
             body, mesh=mesh, axis_names={"pp"},
-            in_specs=(blk_specs, P(None), P(None), P(None)),
+            in_specs=(blk_specs, P(None), P(None), P(None), P(None)),
             out_specs=(P(), blk_specs, P(None), P(None)))(
-                tuple(stacked), mb, lbl_mb, other_arrays)
+                tuple(stacked), mb, lbl_mb, other_arrays, side_mb)
 
         # --- prologue backward from the pipeline's input cotangents
+        # (side inputs are non-differentiated: zero cotangents)
         dx0_full = dx0.reshape((b,) + dx0.shape[2:])
-        pgrads, _dx = prologue_vjp(dx0_full)
+        pgrads, _dx = prologue_vjp(
+            (dx0_full,) + tuple(jnp.zeros_like(sa)
+                                for sa in side_arrays))
 
         # --- write grads back onto the model's parameters
         for i, (name, p) in enumerate(other):

@@ -382,10 +382,19 @@ def forward_hidden(params, input_ids, cfg: GPTConfig,
 
     if pcfg.pp > 1:
         if pcfg.vpp_chunks > 1:
-            raise NotImplementedError(
-                "forward_hidden (eval/inference) does not run the "
-                "interleaved-VPP layout; evaluate with vpp_chunks=1 "
-                "(same weights reshaped) or through the training step")
+            # relayout the interleaved [pp, v, Lc, ...] stacking back to
+            # the plain [pp, L/pp, ...] eval layout: virtual stage
+            # sigma = j*pp + s lives at [s, j], so [pp, v] -> [v, pp]
+            # -> flat [L] recovers layer order; the re-split across pp
+            # is a resharding GSPMD handles (eval pays one relayout,
+            # training keeps the interleaved stacking untouched)
+            v = pcfg.vpp_chunks
+            L = cfg.num_layers
+            blocks = jax.tree_util.tree_map(
+                lambda p: p.swapaxes(0, 1)
+                .reshape((L,) + p.shape[3:])
+                .reshape((pcfg.pp, L // pcfg.pp) + p.shape[3:]),
+                blocks)
         from paddle_tpu.parallel.pipeline import (pipeline_apply,
                                                   pipeline_microbatch)
         mb = pipeline_microbatch(x, pcfg.microbatches)

@@ -96,10 +96,19 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params, x_microbatches,
                         last_stage_grad: Callable,
                         head_params=None,
                         axis_name: str = "pp",
-                        grad_dtype=jnp.float32):
+                        grad_dtype=jnp.float32,
+                        side_inputs=None):
     """Run the interleaved pipeline inside shard_map.
 
     stage_fn(params, x) -> y                   same signature per stage
+        (with `side_inputs`: stage_fn(params, x, side) -> y)
+    side_inputs: optional pytree of [M, ...] per-microbatch values every
+        stage reads alongside its activation (attention masks, position
+        ids — the reference PipelineLayer's tuple-valued stage IO,
+        pp_layers.py:56). They are NON-differentiated side inputs: the
+        forward leg indexes them at its microbatch, the backward leg's
+        recompute closes over the SAME microbatch's values, and no
+        cotangent is produced for them (masks/ids carry none).
     stage_params: pytree with leading dim 1 on each device (stage-
         stacked weights sharded over `axis_name`, as inside shard_map)
     x_microbatches: [M, ...] microbatched stage-0 input (replicated)
@@ -134,6 +143,12 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params, x_microbatches,
     head_params_v = (None if head_params is None else
                      jax.tree_util.tree_map(_varying, head_params))
 
+    def _stage(params, x, mb_idx):
+        if side_inputs is None:
+            return stage_fn(params, x)
+        side = jax.tree_util.tree_map(lambda l: l[mb_idx], side_inputs)
+        return stage_fn(params, x, side)
+
     x_shape = x_microbatches.shape[1:]
     dtype = x_microbatches.dtype
     act0 = _varying(jnp.zeros(x_shape, dtype))
@@ -155,7 +170,7 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params, x_microbatches,
         f_active = (mf >= 0) & (mf < m)
         f_act = jnp.where(s == 0, x_microbatches[jnp.clip(mf, 0, m - 1)],
                           act_in)
-        y = stage_fn(my_params, f_act)
+        y = _stage(my_params, f_act, jnp.clip(mf, 0, m - 1))
         # stash this tick's stage input (ring slot t mod K) BEFORE the
         # backward read: the last stage's B reads its own tick's slot
         stash = lax.dynamic_update_index_in_dim(
@@ -169,7 +184,9 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params, x_microbatches,
         b_active = (mb >= 0) & (mb < m)
         cot = jnp.where(is_last, dy_seed, cot_in)
         x_b = stash[jnp.mod(t - 2 * (n - 1 - s), k)]
-        _, vjp = jax.vjp(stage_fn, my_params, x_b)
+        mb_c = jnp.clip(mb, 0, m - 1)
+        _, vjp = jax.vjp(lambda p, xx: _stage(p, xx, mb_c),
+                         my_params, x_b)
         dp, dx = vjp(cot.astype(y.dtype))
         gmask = b_active
         grads = jax.tree_util.tree_map(

