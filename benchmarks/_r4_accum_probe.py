@@ -27,7 +27,7 @@ def main():
     windows = int(os.environ.get("WINDOWS", "3"))
     cfg = GPTConfig(vocab_size=50304, hidden_size=2048, num_layers=24,
                     num_heads=16, max_seq_len=1024)
-    batch, seq = 4, 1024
+    batch, seq = int(os.environ.get("B", "4")), 1024
     pcfg = GH.ParallelConfig(dp=1, pp=1, tp=1, remat=True,
                              remat_policy="names", scan_unroll=1,
                              param_dtype=jnp.bfloat16,

@@ -1,0 +1,71 @@
+"""E2E probe: hybrid causal-fwd attention vs 'simple' in the flagship
+bench config (the only comparison that counts — isolated kernel wins
+have lied before, NOTES round 3)."""
+import sys, time
+sys.path.insert(0, ".")
+import numpy as np
+
+
+def main():
+    import jax, jax.numpy as jnp
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.models import gpt_hybrid as GH
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    from paddle_tpu.ops.pallas import causal_attention as cak
+    import os
+
+    which = os.environ.get("ATTN", "simple")
+    B = int(os.environ.get("B", "4"))
+    policy = os.environ.get("POLICY", "names")
+    if which == "hybrid":
+        orig = fa.flash_attention_maybe
+
+        def patched(q, k, v, causal=False, scale=None):
+            if causal and q.shape[1] == k.shape[1]:
+                bhsd = (q.shape[0], q.shape[2], q.shape[1], q.shape[3])
+                if cak.supported(bhsd, q.dtype):
+                    qt = jnp.swapaxes(q, 1, 2)
+                    kt = jnp.swapaxes(k, 1, 2)
+                    vt = jnp.swapaxes(v, 1, 2)
+                    out = cak.attention_bhsd_hybrid(qt, kt, vt,
+                                                    causal=True,
+                                                    scale=scale)
+                    return jnp.swapaxes(out, 1, 2)
+            return orig(q, k, v, causal=causal, scale=scale)
+        fa.flash_attention_maybe = patched
+
+    cfg = GPTConfig(vocab_size=50304, hidden_size=2048, num_layers=24,
+                    num_heads=16, max_seq_len=1024)
+    kw = dict(dp=1, pp=1, tp=1, remat=True, scan_unroll=1,
+              param_dtype=jnp.bfloat16, compute_dtype=jnp.bfloat16)
+    if policy == "names5":
+        kw.update(remat_policy="names",
+                  remat_save_names=("attn_out", "ffn1", "qkv", "proj",
+                                    "ffn2"))
+    elif policy == "names3s":
+        kw.update(remat_policy="names",
+                  remat_save_names=("attn_out",))
+    else:
+        kw.update(remat_policy=policy)
+    pcfg = GH.ParallelConfig(**kw)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, 1024)))
+    mesh, params, opt, step = GH.setup(cfg, pcfg, seed=0,
+                                       devices=jax.devices()[:1])
+    with mesh:
+        for _ in range(2):
+            params, opt, loss = step(params, opt, (ids, ids))
+        float(loss)
+        for w in range(3):
+            t0 = time.perf_counter()
+            for _ in range(8):
+                params, opt, loss = step(params, opt, (ids, ids))
+            float(loss)
+            dt = time.perf_counter() - t0
+            print(f"{which} B{B} {policy} w{w}: {dt/8*1e3:.1f} "
+                  f"ms/step {B*1024*8/dt:.0f} tok/s "
+                  f"loss={float(loss):.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

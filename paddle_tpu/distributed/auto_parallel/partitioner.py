@@ -285,34 +285,52 @@ class PipelinePartition:
                     f"pipeline blocks taking Tensor KWARGS ({kk!r}) "
                     "are not supported — pass tensor side inputs "
                     "positionally")
+        def _same_extra(v0, vi):
+            """Per-block equality that never silently passes: same
+            traced Tensor object => provably same value; otherwise a
+            type-aware comparison (array-likes via np.array_equal —
+            a bare != would raise ambiguous-truth on them)."""
+            if v0 is vi:
+                return True
+            if isinstance(v0, Tensor) or isinstance(vi, Tensor):
+                return False      # distinct (or mixed) tensor objects
+            try:
+                return bool(v0 == vi)
+            except Exception:
+                try:
+                    return bool(np.array_equal(v0, vi))
+                except Exception:
+                    return False
+
         for bi, (a_, k_) in enumerate(records[1:], 1):
             if len(a_) != len(probe_a) or set(k_) != set(probe_k):
                 raise NotImplementedError(
                     "pipeline blocks must share one call signature; "
                     f"block {bi} differs from block 0")
             for i, (v0, vi) in enumerate(zip(probe_a, a_)):
-                both_t = isinstance(v0, Tensor) and isinstance(vi,
-                                                               Tensor)
-                if both_t:
-                    # same traced object => provably the same value;
-                    # distinct objects may differ per block (rotary
-                    # caches, layer indices) which the scanned replay
-                    # cannot honor
-                    if v0 is not vi:
-                        raise NotImplementedError(
-                            f"block argument {i} varies per block "
-                            "(different tensors at block 0 and "
-                            f"{bi}); per-block-varying side inputs "
-                            "are not supported by the generic "
-                            "partitioner")
-                elif v0 is not vi and v0 != vi:
+                if not _same_extra(v0, vi):
                     raise NotImplementedError(
-                        f"static block argument {i} varies per block "
-                        f"({v0!r} at block 0, {vi!r} at block {bi}) — "
-                        "the scanned stage replays ONE value for all "
-                        "layers")
+                        f"block argument {i} varies per block "
+                        f"(block 0 vs block {bi}) — the scanned stage "
+                        "replays ONE value for all layers; per-block-"
+                        "varying extras are not supported by the "
+                        "generic partitioner")
+            for kk in probe_k:
+                if not _same_extra(probe_k[kk], k_[kk]):
+                    raise NotImplementedError(
+                        f"block kwarg {kk!r} varies per block "
+                        f"(block 0 vs block {bi}) — the scanned stage "
+                        "replays ONE value for all layers")
         side_pos = [i for i, v in enumerate(probe_a)
                     if isinstance(v, Tensor)]
+        if side_pos:
+            import warnings
+            warnings.warn(
+                "pipeline blocks receive tensor side inputs (args "
+                f"{side_pos}); these are treated as NON-differentiated "
+                "(mask/position-id semantics) — if a side input "
+                "depends on trainable prologue parameters, that "
+                "gradient path is dropped", stacklevel=2)
         static_args = {i: v for i, v in enumerate(probe_a)
                        if not isinstance(v, Tensor)}
         static_kwargs = dict(probe_k)

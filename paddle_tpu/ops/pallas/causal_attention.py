@@ -202,3 +202,53 @@ def attention_bhsd(q, k, v, causal=True, scale=None, interpret=False):
     d = q.shape[-1]
     sm = scale if scale is not None else 1.0 / math.sqrt(d)
     return causal_attention(q, k, v, sm, interpret)
+
+
+# ---------------------------------------------------------------------
+# Hybrid (round 4): causal-skip strips FORWARD, monolithic BACKWARD.
+#
+# The strip forward does ~(nq+1)/(2*nq) of the full-matrix MXU+VPU work
+# (62.5% at nq=4); the backward reuses simple_attention's monolithic
+# kernel with residuals (q, k, v) ONLY — no lse/o saves, byte-identical
+# backward liveness to the e2e-proven 'simple' path (the round-3
+# full-causal kernel's extra residuals were the OOM suspect, NOTES).
+# ---------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def causal_fwd_attention(q, k, v, sm_scale, interpret=False):
+    """q/k/v: [B, H, S, D] -> [B, H, S, D]; causal only."""
+    return _fwd_light(q, k, v, sm_scale, interpret)[0]
+
+
+def hybrid_supported(q_shape, dtype):
+    """Feasibility = strip FORWARD fits AND the monolithic BACKWARD
+    fits (simple_attention's full-S^2 budget): gating on the forward
+    alone would accept long-S shapes whose backward blows VMEM."""
+    from paddle_tpu.ops.pallas import simple_attention as sak
+    return supported(q_shape, dtype) and sak.supported(q_shape, dtype)
+
+
+def _fwd_light(q, k, v, sm_scale, interpret):
+    o, (q_, k_, v_, _o, _lse) = _fwd(q, k, v, sm_scale, interpret)
+    return o, (q_, k_, v_)
+
+
+def _bwd_light(sm_scale, interpret, res, do):
+    from paddle_tpu.ops.pallas import simple_attention as sak
+    return sak._bwd(sm_scale, True, interpret, res, do)
+
+
+causal_fwd_attention.defvjp(_fwd_light, _bwd_light)
+
+
+def attention_bhsd_hybrid(q, k, v, causal=True, scale=None,
+                          interpret=False):
+    assert causal, "causal_fwd_attention is causal-only"
+    if not hybrid_supported(q.shape, q.dtype):
+        raise ValueError(
+            f"hybrid attention unsupported for shape {q.shape} "
+            f"{q.dtype}: the monolithic backward must also fit VMEM "
+            "(check hybrid_supported() before calling)")
+    d = q.shape[-1]
+    sm = scale if scale is not None else 1.0 / math.sqrt(d)
+    return causal_fwd_attention(q, k, v, sm, interpret)
