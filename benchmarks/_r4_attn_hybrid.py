@@ -23,7 +23,10 @@ def main():
         def patched(q, k, v, causal=False, scale=None):
             if causal and q.shape[1] == k.shape[1]:
                 bhsd = (q.shape[0], q.shape[2], q.shape[1], q.shape[3])
-                if cak.supported(bhsd, q.dtype):
+                # hybrid needs BOTH the strip forward and the
+                # monolithic backward to fit — supported() alone
+                # admits shapes whose hybrid path raises
+                if cak.hybrid_supported(bhsd, q.dtype):
                     qt = jnp.swapaxes(q, 1, 2)
                     kt = jnp.swapaxes(k, 1, 2)
                     vt = jnp.swapaxes(v, 1, 2)

@@ -1,0 +1,127 @@
+"""Zero-bubble probe: compiled 1F1B vs ZBH1 vs ZB-V (ZBVPP) at pp=4,
+M=8 on the same 8-layer tanh model — temp memory (memory_analysis) and
+schedule-descriptor makespan/bubble, the VERDICT round-3 item-3 "Done"
+measurements extended to the V schedule.
+
+1F1B/ZBH1 run 4 stages x 2 layers; ZB-V runs the same 8 layers as 8
+V-placed virtual stages (1 layer each). Run:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python benchmarks/_r4_zb_probe.py [M] [HID]
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from paddle_tpu._testing import unshim_axon
+    unshim_axon()
+except Exception:
+    pass
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import shard_map  # noqa: E402
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+
+from paddle_tpu.parallel.pipeline_1f1b import (  # noqa: E402
+    compiled_1f1b_schedule, compiled_zbh1_schedule,
+    compiled_zbvpp_schedule, pipeline_train_1f1b, pipeline_train_zbh1,
+    pipeline_train_zbvpp)
+
+N = 4
+
+
+def mem_stats(jitted, *args):
+    c = jitted.lower(*args).compile()
+    ma = c.memory_analysis()
+    return ma.temp_size_in_bytes
+
+
+def main():
+    m = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    hid = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    mesh = Mesh(np.array(jax.devices()[:N]), ("pp",))
+    rng = np.random.RandomState(0)
+    xs = jnp.asarray(rng.randn(m, 2, hid).astype(np.float32))
+    tgt = jnp.asarray(rng.randn(m, 2, hid).astype(np.float32))
+    hw = jnp.asarray(rng.randn(hid, hid).astype(np.float32))
+    W8 = jnp.asarray(rng.randn(8, hid, hid).astype(np.float32))
+
+    def last_grad(y, hp, mb):
+        def head_loss(hp_, y_):
+            return jnp.mean((y_ @ hp_ - tgt[mb]) ** 2) / m
+        l, (ghp, gy) = jax.value_and_grad(
+            head_loss, argnums=(0, 1))(hp, y)
+        return l, gy, ghp
+
+    # 4 stages x 2 layers
+    def stage2(w, x):
+        return jnp.tanh(jnp.tanh(x @ w[0]) @ w[1])
+
+    # 8 virtual stages x 1 layer
+    def stage1(w, x):
+        return jnp.tanh(x @ w)
+
+    W42 = W8.reshape(N, 2, hid, hid)
+    vidx = np.stack([np.arange(N), 2 * N - 1 - np.arange(N)], axis=1)
+    Wzv = W8[vidx]                                   # [N, 2, h, h]
+
+    def run(fn, stage):
+        return shard_map(
+            lambda W_, xs_, hw_: fn(stage, W_, xs_, last_grad,
+                                    head_params=hw_),
+            mesh=mesh, axis_names={"pp"},
+            in_specs=(P("pp"), P(None), P(None)),
+            out_specs=(P(), P("pp"), P(), P(None)))
+
+    with mesh:
+        j1 = jax.jit(run(pipeline_train_1f1b, stage2))
+        jz = jax.jit(run(pipeline_train_zbh1, stage2))
+        jv = jax.jit(run(pipeline_train_zbvpp, stage1))
+        t1 = mem_stats(j1, W42, xs, hw)
+        tz = mem_stats(jz, W42, xs, hw)
+        tv = mem_stats(jv, Wzv, xs, hw)
+
+        def timeit(j, W):
+            import time
+            j(W, xs, hw)[0].block_until_ready()     # warmup
+            t0 = time.perf_counter()
+            for _ in range(10):
+                out = j(W, xs, hw)
+            out[0].block_until_ready()
+            return (time.perf_counter() - t0) / 10 * 1e3
+
+        ms1, msz, msv = (timeit(j1, W42), timeit(jz, W42),
+                         timeit(jv, Wzv))
+
+    print(f"pp={N} M={m} hid={hid}  (same 8-layer model)")
+    print(f"temp bytes: 1f1b={t1/1e6:.1f}MB zbh1={tz/1e6:.1f}MB "
+          f"zbvpp={tv/1e6:.1f}MB")
+    print(f"wall ms/step (8-dev CPU mesh): 1f1b={ms1:.1f} "
+          f"zbh1={msz:.1f} zbvpp={msv:.1f}")
+    s1 = compiled_1f1b_schedule(N, m)
+    # honest fused durations for the lockstep 1F1B: F=1, B=3
+    s1.durations = {"F": 1.0, "B": 3.0}
+    mk1, bb1 = s1.simulate()
+    mkz, bbz = compiled_zbh1_schedule(N, m).simulate()
+    mkv, bbv = compiled_zbvpp_schedule(N, m).simulate()
+    # zbvpp stages are half-size: scale its makespan to the same
+    # per-layer unit (F unit there covers 1 layer, not 2)
+    print(f"makespan (per-2-layer units): 1f1b={mk1} zbh1={mkz} "
+          f"zbvpp={mkv/2:.1f}")
+    print(f"bubble: 1f1b={bb1:.4f} zbh1={bbz:.4f} zbvpp={bbv:.4f}")
+    print(f"peak live acts: 1f1b={s1.peak_activations()} "
+          f"zbh1={compiled_zbh1_schedule(N, m).peak_activations()} "
+          f"zbvpp={compiled_zbvpp_schedule(N, m).peak_activations()}")
+
+
+if __name__ == "__main__":
+    main()

@@ -107,7 +107,15 @@ class Engine:
 
     # --------------------------------------------------------- prepare
     def prepare(self, n_chips: Optional[int] = None,
-                global_batch: int = 32, plan=None):
+                global_batch: int = 32, plan=None,
+                zero_bubble=False):
+        """zero_bubble compiles pp>1 plans onto a zero-bubble
+        dx/dW-split ring instead of 1F1B when the plan's stage bodies
+        are collective-free (tp==1); ignored otherwise — mirrors
+        planner.PlanCandidate.to_parallel_config(zero_bubble=...).
+        True selects ZBH1; the string "zbvpp" selects the two-chunk
+        V-placement schedule (needs blocks % 2*pp == 0)."""
+        self._zero_bubble = zero_bubble
         import paddle_tpu as paddle
 
         self._devices = jax.devices()[:n_chips] if n_chips else \
@@ -164,9 +172,14 @@ class Engine:
             # gets the bubble-friendly 2*pp
             mbs = best.microbatches if best.microbatches > 1 \
                 else 2 * best.pp
+            zb = getattr(self, "_zero_bubble", False)
+            if zb and best.tp == 1:
+                sched = zb if isinstance(zb, str) else "zbh1"
+            else:
+                sched = "1f1b"
             self._partition = PipelinePartition(
                 self.model, self.loss, blocks, self._mesh, best.pp,
-                microbatches=mbs)
+                microbatches=mbs, pp_schedule=sched)
 
             def train_step(xb, yb):
                 loss = self._partition.train_grads(xb, yb)

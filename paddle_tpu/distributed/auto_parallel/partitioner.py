@@ -123,16 +123,35 @@ class PipelinePartition:
     """The pp execution plan for one model: blocks + shim machinery."""
 
     def __init__(self, model, loss_fn, blocks, mesh: Mesh, pp: int,
-                 microbatches: int):
+                 microbatches: int, pp_schedule: str = "1f1b"):
         if len(blocks) % pp:
             raise ValueError(
                 f"{len(blocks)} pipeline blocks not divisible by "
                 f"pp={pp}")
+        if pp_schedule not in ("1f1b", "zbh1", "zbvpp"):
+            raise ValueError(
+                f"partitioner pp_schedule must be '1f1b', 'zbh1' or "
+                f"'zbvpp', got {pp_schedule!r}")
+        if pp_schedule in ("zbh1", "zbvpp") and "mp" in mesh.shape \
+                and mesh.shape["mp"] > 1:
+            raise ValueError(
+                f"pp_schedule={pp_schedule!r} requires a "
+                "collective-free stage "
+                "body (tp=1): the zero-bubble phases are cond-gated "
+                "per stage and GSPMD tp collectives inside a cond "
+                "branch deadlock the mesh (gpt_hybrid."
+                "_validate_pp_schedule has the full diagnosis)")
+        if pp_schedule == "zbvpp" and len(blocks) % (2 * pp):
+            raise ValueError(
+                f"{len(blocks)} pipeline blocks not divisible by "
+                f"2*pp={2 * pp} (pp_schedule='zbvpp' splits the chain "
+                "into 2*pp V-placed chunks)")
         self.model = model
         self.loss_fn = loss_fn
         self.blocks = blocks
         self.mesh = mesh
         self.pp = pp
+        self.pp_schedule = pp_schedule
         self.microbatches = microbatches
         self.template = blocks[0]
         # param bookkeeping: block params (stacked into the pipeline)
@@ -378,11 +397,24 @@ class PipelinePartition:
             for sa, bf in zip(side_arrays, batchful))
 
         stacked = self.stacked_blocks()
-        stacked = [
-            lax.with_sharding_constraint(
-                s.reshape((pp, L // pp) + s.shape[1:]),
-                NamedSharding(mesh, P("pp", *[None] * s.ndim)))
-            for s in stacked]
+        if self.pp_schedule == "zbvpp":
+            # ZB-V placement: virtual stage sigma owns block chunk
+            # sigma; device s holds chunks s (lane 0) and 2pp-1-s
+            # (lane 1) -> leaves [pp, 2, Lc, ...]
+            Lc = L // (2 * pp)
+            vidx = np.stack([np.arange(pp),
+                             2 * pp - 1 - np.arange(pp)], axis=1)
+            stacked = [
+                lax.with_sharding_constraint(
+                    s.reshape((2 * pp, Lc) + s.shape[1:])[vidx],
+                    NamedSharding(mesh, P("pp", *[None] * (s.ndim + 1))))
+                for s in stacked]
+        else:
+            stacked = [
+                lax.with_sharding_constraint(
+                    s.reshape((pp, L // pp) + s.shape[1:]),
+                    NamedSharding(mesh, P("pp", *[None] * s.ndim)))
+                for s in stacked]
 
         def stage_fn(stage_params, xm, side=()):
             extra = []
@@ -426,12 +458,17 @@ class PipelinePartition:
                 head_loss, argnums=(0, 1))(hp, y)
             return l, gy, ghp
 
-        from paddle_tpu.parallel.pipeline_1f1b import pipeline_train_1f1b
+        from paddle_tpu.parallel.pipeline_1f1b import (
+            pipeline_train_1f1b, pipeline_train_zbh1,
+            pipeline_train_zbvpp)
         from jax import shard_map
         blk_specs = tuple(P("pp") for _ in stacked)
+        pipe_fn = {"zbh1": pipeline_train_zbh1,
+                   "zbvpp": pipeline_train_zbvpp,
+                   "1f1b": pipeline_train_1f1b}[self.pp_schedule]
 
         def body(stacked, mb, lbl_mb_, head_arrays, side_mb_):
-            return pipeline_train_1f1b(
+            return pipe_fn(
                 stage_fn, tuple(stacked), mb,
                 last_grad, head_params=list(head_arrays),
                 side_inputs=side_mb_ if side_mb_ else None)
@@ -454,7 +491,19 @@ class PipelinePartition:
             g = pgrads[i] + hgrads[i]
             self._acc_grad(p, g)
         for pos in range(len(stacked)):
-            flat = sgrads[pos].reshape((L,) + sgrads[pos].shape[2:])
+            if self.pp_schedule == "zbvpp":
+                # invert the V gather: chunk sigma's grads sit at
+                # [sigma, 0] (sigma < pp) / [2pp-1-sigma, 1]
+                g = sgrads[pos]                    # [pp, 2, Lc, ...]
+                Lc = L // (2 * pp)
+                ds = np.concatenate([np.arange(pp),
+                                     np.arange(pp - 1, -1, -1)])
+                ls = np.concatenate([np.zeros(pp, np.int64),
+                                     np.ones(pp, np.int64)])
+                flat = g[ds, ls].reshape((L,) + g.shape[3:])
+            else:
+                flat = sgrads[pos].reshape(
+                    (L,) + sgrads[pos].shape[2:])
             for li in range(L):
                 self._acc_grad(self.block_params[li][pos][1], flat[li])
         return Tensor._wrap(loss, True)

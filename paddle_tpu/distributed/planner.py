@@ -92,14 +92,24 @@ class PlanCandidate:
         Consumed by to_parallel_config()."""
         return self.sp and self.tp > 1 and self.pp == 1
 
-    def to_parallel_config(self, **overrides):
+    def to_parallel_config(self, zero_bubble: bool = False,
+                           **overrides):
         """Materialize this plan as a hybrid-engine ParallelConfig
         (models/gpt_hybrid.py), carrying the collective_matmul knob and
-        the zero/microbatch/remat choices. Extra kwargs override."""
+        the zero/microbatch/remat choices. Extra kwargs override.
+
+        zero_bubble=True upgrades the pipeline schedule to the compiled
+        zero-bubble ZBH1 when the plan's stage bodies are
+        collective-free (tp==1 — the cond-gating constraint,
+        gpt_hybrid._validate_pp_schedule); with tp>1 the knob is
+        ignored (1F1B) rather than refused, so planner-driven configs
+        stay runnable."""
         from paddle_tpu.models.gpt_hybrid import ParallelConfig
+        sched = "gpipe" if self.pp <= 1 else (
+            "zbh1" if zero_bubble and self.tp == 1 else "1f1b")
         kw = dict(dp=self.dp, tp=self.tp, pp=self.pp, sp=self.sp,
                   microbatches=self.microbatches,
-                  pp_schedule="1f1b" if self.pp > 1 else "gpipe",
+                  pp_schedule=sched,
                   remat=self.remat, zero1=self.zero >= 1,
                   collective_matmul=self.collective_matmul)
         kw.update(overrides)

@@ -49,7 +49,12 @@ class ParallelConfig:
     # "gpipe": forward rotation + jax.grad (activation liveness grows
     # with microbatches); "1f1b": explicit forward/backward interleave
     # with O(pp) liveness (parallel/pipeline_1f1b.py — the compiled
-    # analog of the reference 1F1B, pipeline_parallel.py:547)
+    # analog of the reference 1F1B, pipeline_parallel.py:547);
+    # "zbh1"/"zbvpp": zero-bubble schedules with cond-gated phases and
+    # dx/dW-split backward (reference pipeline_zero_bubble.py:62/:151)
+    # — require a collective-free stage body (tp=1, no EP-MoE).
+    # "zbvpp" runs TWO model chunks per device in the V placement
+    # (layers split 2*pp ways; num_layers % (2*pp) == 0)
     pp_schedule: str = "gpipe"
     # virtual pipeline chunks per device (interleaved VPP,
     # PipelineParallelWithInterleave pipeline_parallel.py:1143): the
@@ -188,7 +193,24 @@ def shard_params(params, mesh, cfg, pcfg):
         L = cfg.num_layers
         v = pcfg.vpp_chunks
         params = dict(params)
-        if v > 1:
+        if pcfg.pp_schedule == "zbvpp":
+            # ZB-V placement: virtual stage sigma (of 2*pp) owns layers
+            # [sigma*Lc, (sigma+1)*Lc); device s holds vstage s at
+            # [s, 0] and vstage 2*pp-1-s at [s, 1]
+            ng = 2 * pcfg.pp
+            if L % ng:
+                raise ValueError(
+                    f"num_layers {L} not divisible by 2*pp {ng} "
+                    "(pp_schedule='zbvpp' splits the model into 2*pp "
+                    "V-placed chunks)")
+            Lc = L // ng
+            vidx = np.stack([np.arange(pcfg.pp),
+                             ng - 1 - np.arange(pcfg.pp)], axis=1)
+            params["blocks"] = jax.tree_util.tree_map(
+                lambda x: x.reshape((ng, Lc) + x.shape[1:])[vidx],
+                params["blocks"])
+            extra = (None,)
+        elif v > 1:
             if L % (pcfg.pp * v):
                 raise ValueError(
                     f"num_layers {L} not divisible by pp*vpp_chunks "
@@ -381,7 +403,24 @@ def forward_hidden(params, input_ids, cfg: GPTConfig,
                                     params["blocks"])
 
     if pcfg.pp > 1:
-        if pcfg.vpp_chunks > 1:
+        if pcfg.pp_schedule == "zbvpp":
+            # relayout the ZB-V [pp, 2, Lc, ...] stacking back to the
+            # plain [pp, L/pp, ...] eval layout: virtual stage sigma
+            # lives at [sigma, 0] for sigma < pp and [2*pp-1-sigma, 1]
+            # past the turnaround; gathering in sigma order recovers
+            # the layer sequence (same one-relayout cost as VPP eval)
+            npp = pcfg.pp
+            L = cfg.num_layers
+            ds = np.concatenate([np.arange(npp),
+                                 np.arange(npp - 1, -1, -1)])
+            ls = np.concatenate([np.zeros(npp, np.int64),
+                                 np.ones(npp, np.int64)])
+            blocks = jax.tree_util.tree_map(
+                lambda p: p[ds, ls]
+                .reshape((L,) + p.shape[3:])
+                .reshape((npp, L // npp) + p.shape[3:]),
+                blocks)
+        elif pcfg.vpp_chunks > 1:
             # relayout the interleaved [pp, v, Lc, ...] stacking back to
             # the plain [pp, L/pp, ...] eval layout: virtual stage
             # sigma = j*pp + s lives at [s, j], so [pp, v] -> [v, pp]
@@ -637,6 +676,12 @@ def _train_grads_1f1b(params, batch, cfg, pcfg, mesh):
                 pipeline_train_zbh1
             return pipeline_train_zbh1(stage_fn, blocks, mb, last_grad,
                                        head_params=head_params)
+        if pcfg.pp_schedule == "zbvpp":
+            from paddle_tpu.parallel.pipeline_1f1b import \
+                pipeline_train_zbvpp
+            return pipeline_train_zbvpp(stage_fn, blocks, mb,
+                                        last_grad,
+                                        head_params=head_params)
         return pipeline_train_1f1b(stage_fn, blocks, mb, last_grad,
                                    head_params=head_params)
 
@@ -662,19 +707,21 @@ def _validate_pp_schedule(pcfg):
     """Shared pp-schedule validation for every engine builder (fused
     train step, split accum engines) — the deadlock/compat guards must
     not depend on which builder dispatches the pipeline."""
-    if pcfg.pp_schedule not in ("gpipe", "1f1b", "zbh1"):
+    if pcfg.pp_schedule not in ("gpipe", "1f1b", "zbh1", "zbvpp"):
         raise ValueError(
-            f"pp_schedule must be 'gpipe', '1f1b' or 'zbh1', got "
-            f"{pcfg.pp_schedule!r}")
+            f"pp_schedule must be 'gpipe', '1f1b', 'zbh1' or 'zbvpp', "
+            f"got {pcfg.pp_schedule!r}")
     if pcfg.vpp_chunks > 1 and (pcfg.pp <= 1
                                 or pcfg.pp_schedule != "1f1b"):
         raise ValueError(
             "vpp_chunks > 1 requires pp > 1 with pp_schedule='1f1b' "
-            "(the interleaved schedule generalizes the compiled 1F1B)")
-    if pcfg.pp_schedule == "zbh1" and (
+            "(the interleaved schedule generalizes the compiled 1F1B; "
+            "'zbvpp' brings its own two V-placed chunks)")
+    if pcfg.pp_schedule in ("zbh1", "zbvpp") and (
             pcfg.tp > 1 or (pcfg.num_experts > 0 and pcfg.dp > 1)):
         raise ValueError(
-            "pp_schedule='zbh1' requires a collective-free stage body "
+            f"pp_schedule={pcfg.pp_schedule!r} requires a "
+            "collective-free stage body "
             "(tp=1, no expert-parallel MoE): the zero-bubble phases are "
             "cond-gated per pipeline stage, and GSPMD-inserted tp/ep "
             "collectives inside a cond branch deadlock the mesh (half "
@@ -682,12 +729,15 @@ def _validate_pp_schedule(pcfg):
             "the next ring permute). dp composes fine — its gradient "
             "psum sits outside the gated region. Use '1f1b' for "
             "tp/ep hybrids.")
+    if pcfg.pp_schedule == "zbvpp" and pcfg.pp <= 1:
+        raise ValueError("pp_schedule='zbvpp' requires pp > 1 (the "
+                         "V placement spans a pipeline ring)")
 
 
 def build_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,
                      lr=3e-4, state_specs=None):
     _validate_pp_schedule(pcfg)
-    if pcfg.pp > 1 and pcfg.pp_schedule in ("1f1b", "zbh1"):
+    if pcfg.pp > 1 and pcfg.pp_schedule in ("1f1b", "zbh1", "zbvpp"):
         def grads_of(params, batch):
             return _train_grads_1f1b(params, batch, cfg, pcfg, mesh)
     else:
@@ -752,7 +802,7 @@ def _make_grad_acc(cfg, pcfg, mesh):
     with pipeline identically in both engines (reference:
     auto_parallel_gradient_merge composing with the pipeline passes)."""
     _validate_pp_schedule(pcfg)
-    if pcfg.pp > 1 and pcfg.pp_schedule in ("1f1b", "zbh1"):
+    if pcfg.pp > 1 and pcfg.pp_schedule in ("1f1b", "zbh1", "zbvpp"):
         def grads_of(params, batch):
             return _train_grads_1f1b(params, batch, cfg, pcfg, mesh)
     else:

@@ -11,7 +11,7 @@ from .ulysses import ulysses_attention, ulysses_attention_sharded  # noqa: F401
 from .pipeline import pipeline_apply, stack_stage_params  # noqa: F401
 from .pp_schedule import (  # noqa: F401
     PipeOp, Schedule, run_schedule, schedule_1f1b, schedule_fthenb,
-    schedule_interleaved, schedule_zbh1,
+    schedule_interleaved, schedule_zbh1, schedule_zbvpp,
 )
 from .sequence import (  # noqa: F401
     shard_sequence, gather_sequence, sequence_parallel_enabled,

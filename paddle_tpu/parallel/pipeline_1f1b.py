@@ -52,17 +52,20 @@ def _varying_cast(axis_name: str, x):
 
 
 def _pipeline_epilogue(axis_name, s, n, loss, head, dx0_buf, grads,
-                       grad_dtype, dtype):
+                       grad_dtype, dtype, head_stage=None):
     """Shared final psums of every compiled pipeline variant: loss and
-    head grads live on the last stage, dx0 on stage 0 — psum replicates
-    them (masked elsewhere-zero). The dx0 psum runs in f32: a bf16 dx0
-    all-reduce gets combined with the f32 grad all-reduces into one
-    variadic op, and XLA:CPU's AllReducePromotion pass CHECK-crashes
-    cloning a mixed-dtype variadic all-reduce (TPU is unaffected)."""
-    loss = lax.psum(jnp.where(s == n - 1, loss, 0.0), axis_name)
+    head grads live on the head stage (the last *virtual* stage's
+    device: n-1 for linear placements, 0 for the ZB-V placement), dx0
+    on stage 0 — psum replicates them (masked elsewhere-zero). The dx0
+    psum runs in f32: a bf16 dx0 all-reduce gets combined with the f32
+    grad all-reduces into one variadic op, and XLA:CPU's
+    AllReducePromotion pass CHECK-crashes cloning a mixed-dtype
+    variadic all-reduce (TPU is unaffected)."""
+    hs = n - 1 if head_stage is None else head_stage
+    loss = lax.psum(jnp.where(s == hs, loss, 0.0), axis_name)
     if head is not None:
         head = jax.tree_util.tree_map(
-            lambda g: lax.psum(jnp.where(s == n - 1, g,
+            lambda g: lax.psum(jnp.where(s == hs, g,
                                          jnp.zeros_like(g)), axis_name),
             head)
     dx0 = lax.psum(
@@ -399,18 +402,21 @@ def pipeline_train_interleaved(stage_fn: Callable, stage_params,
 # Compiled zero-bubble ZBH1 — round 4
 # ---------------------------------------------------------------------
 
-def _zbh1_w_recurrence(n: int, m: int, s: int):
-    """The (static) W-firing recurrence of stage s: at tick t, with nW
-    W's already retired, fire iff pending B's exist AND (the stage's F
-    lane is idle — cooldown/drain — OR the backlog exceeds s, the ZBH1
-    'defer the first s weight-grads' policy, pp_schedule.py
-    schedule_zbh1). Yields (t, fired) until all m W's retire."""
+def _zb_w_recurrence(ng: int, m: int, sigma: int):
+    """The (static) W-firing recurrence of virtual stage `sigma` in an
+    ng-deep pipeline: at tick t, with nW W's already retired, fire iff
+    pending B's exist AND (the stage's F lane is idle — cooldown/drain
+    — OR the backlog exceeds sigma, the zero-bubble 'defer the first
+    sigma weight-grads' policy, pp_schedule.py schedule_zbh1). Yields
+    (t, fired) until all m W's retire. ZBH1 instantiates it with
+    ng = n_stages, sigma = s; ZB-V with ng = 2n and the V-placement
+    virtual depths."""
     nW, t = 0, 0
     while nW < m:
-        nB = min(max(t - 2 * (n - 1) + s + 1, 0), m)
-        f_active = 0 <= t - s < m
+        nB = min(max(t - 2 * (ng - 1) + sigma + 1, 0), m)
+        f_active = 0 <= t - sigma < m
         pending = nB - nW
-        fired = pending > 0 and ((not f_active) or pending > s)
+        fired = pending > 0 and ((not f_active) or pending > sigma)
         if fired:
             nW += 1
         yield t, fired
@@ -423,7 +429,7 @@ def zbh1_extra_ticks(n_stages: int, n_microbatches: int) -> int:
     T = n_microbatches + 2 * (n_stages - 1)
     extra = 0
     for s in range(n_stages):
-        last = max(t for t, f in _zbh1_w_recurrence(
+        last = max(t for t, f in _zb_w_recurrence(
             n_stages, n_microbatches, s) if f)
         extra = max(extra, last + 1 - T)
     return max(extra, 0)
@@ -445,7 +451,7 @@ def compiled_zbh1_schedule(n_stages: int, n_microbatches: int) -> Schedule:
     T = m + 2 * (n - 1) + zbh1_extra_ticks(n, m)
     per_stage = []
     for s in range(n):
-        fires = dict(_zbh1_w_recurrence(n, m, s))
+        fires = dict(_zb_w_recurrence(n, m, s))
         ops = []
         nW = 0
         for t in range(T):
@@ -467,7 +473,8 @@ def pipeline_train_zbh1(stage_fn: Callable, stage_params, x_microbatches,
                         last_stage_grad: Callable,
                         head_params=None,
                         axis_name: str = "pp",
-                        grad_dtype=jnp.float32):
+                        grad_dtype=jnp.float32,
+                        side_inputs=None):
     """Zero-bubble ZBH1 on the compiled 1F1B ring.
 
     Two departures from `pipeline_train_1f1b`:
@@ -488,7 +495,11 @@ def pipeline_train_zbh1(stage_fn: Callable, stage_params, x_microbatches,
        pipeline_zero_bubble.py:62. Memory premium over 1F1B: the
        (n+1)-deep W stash — reported by the memory probe.
 
-    Same contract and return values as pipeline_train_1f1b.
+    Same contract and return values as pipeline_train_1f1b, including
+    `side_inputs` (non-differentiated [M, ...] per-microbatch values:
+    the forward leg indexes them at its microbatch, the B recompute at
+    its, and the deferred W recompute at the microbatch it retires —
+    W's fire in microbatch order, so nW IS that index).
     """
     n = lax.axis_size(axis_name)
     s = lax.axis_index(axis_name)
@@ -504,6 +515,12 @@ def pipeline_train_zbh1(stage_fn: Callable, stage_params, x_microbatches,
 
     def _v(x):
         return _varying_cast(axis_name, x)
+
+    def _stage(params, x, mb_idx):
+        if side_inputs is None:
+            return stage_fn(params, x)
+        side = jax.tree_util.tree_map(lambda l: l[mb_idx], side_inputs)
+        return stage_fn(params, x, side)
 
     head_params_v = (None if head_params is None else
                      jax.tree_util.tree_map(_v, head_params))
@@ -527,11 +544,14 @@ def pipeline_train_zbh1(stage_fn: Callable, stage_params, x_microbatches,
     def w_phase(nW, grads, wstash_x, wstash_gy, fire):
         """Retire ONE deferred weight-grad when `fire`: recompute the
         stage forward from the stashed input under vjp wrt params and
-        accumulate dW. Identity (skipped work) otherwise."""
+        accumulate dW. Identity (skipped work) otherwise. W's retire in
+        microbatch order, so nW doubles as the side-input index."""
         def do(g):
             x_w = wstash_x[jnp.mod(nW, wk)]
             gy_w = wstash_gy[jnp.mod(nW, wk)]
-            _, vjpp = jax.vjp(lambda pp: stage_fn(pp, x_w), my_params)
+            mb_w = jnp.clip(nW, 0, m - 1)
+            _, vjpp = jax.vjp(lambda pp: _stage(pp, x_w, mb_w),
+                              my_params)
             (dp,) = vjpp(gy_w)
             return _v(jax.tree_util.tree_map(
                 lambda a, d: a + d.astype(a.dtype), g, dp))
@@ -544,10 +564,10 @@ def pipeline_train_zbh1(stage_fn: Callable, stage_params, x_microbatches,
         # ---------------- forward (cond-gated)
         mf = t - s
         f_active = (mf >= 0) & (mf < m)
-        f_act = jnp.where(s == 0, x_microbatches[jnp.clip(mf, 0, m - 1)],
-                          act_in)
+        mf_c = jnp.clip(mf, 0, m - 1)
+        f_act = jnp.where(s == 0, x_microbatches[mf_c], act_in)
         y = lax.cond(f_active,
-                     lambda: _v(stage_fn(my_params, f_act)),
+                     lambda: _v(_stage(my_params, f_act, mf_c)),
                      lambda: _v(jnp.zeros(x_shape, dtype)))
         stash = lax.dynamic_update_index_in_dim(
             stash, f_act, jnp.mod(t, k), 0)
@@ -566,9 +586,11 @@ def pipeline_train_zbh1(stage_fn: Callable, stage_params, x_microbatches,
         b_active = (mb >= 0) & (mb < m)
         cot = jnp.where(is_last, dy_seed, cot_in)
         x_b = stash[jnp.mod(t - 2 * (n - 1 - s), k)]
+        mb_c = jnp.clip(mb, 0, m - 1)
 
         def b_do():
-            _, vjpx = jax.vjp(lambda xx: stage_fn(my_params, xx), x_b)
+            _, vjpx = jax.vjp(
+                lambda xx: _stage(my_params, xx, mb_c), x_b)
             (dx,) = vjpx(cot.astype(y.dtype))
             return _v(dx)
 
@@ -624,3 +646,303 @@ def pipeline_train_zbh1(stage_fn: Callable, stage_params, x_microbatches,
 
     return _pipeline_epilogue(axis_name, s, n, loss, head, dx0_buf,
                               grads, grad_dtype, dtype)
+
+
+# ---------------------------------------------------------------------
+# Compiled zero-bubble ZB-V (ZBVPP) — round 4
+# ---------------------------------------------------------------------
+
+def zbvpp_extra_ticks(n_stages: int, n_microbatches: int) -> int:
+    """Drain ticks past the ZB-V grid (m + 2(2n-1) ticks) that the
+    deferred W backlogs need, worst over both lanes of every device."""
+    ng = 2 * n_stages
+    T = n_microbatches + 2 * (ng - 1)
+    extra = 0
+    for sigma in range(ng):
+        last = max(t for t, f in _zb_w_recurrence(
+            ng, n_microbatches, sigma) if f)
+        extra = max(extra, last + 1 - T)
+    return max(extra, 0)
+
+
+def compiled_zbvpp_schedule(n_stages: int,
+                            n_microbatches: int) -> Schedule:
+    """The exact (device, tick) -> phases timeline `pipeline_train_zbvpp`
+    compiles, as a checkable Schedule (chunk_dirs=[1,-1]: the ZB-V
+    placement — device s holds virtual stages s and 2n-1-s, so both
+    chunk turnarounds are device-local and the last virtual stage sits
+    on DEVICE 0). F/B ride the lockstep grid of the 2n-deep virtual
+    pipeline; B is input-grad only (cost 2: stage recompute + dx) and
+    each virtual stage's deferred W (cost 2: recompute + dW) fires per
+    the zero-bubble backlog recurrence with defer bound sigma.
+
+    Reference: pipeline_zero_bubble.py:151 (ZBVPP's B/W split and V
+    placement)."""
+    n, m = n_stages, n_microbatches
+    ng = 2 * n
+    T = m + 2 * (ng - 1) + zbvpp_extra_ticks(n, m)
+    per_stage = []
+    for s in range(n):
+        sig = {0: s, 1: ng - 1 - s}
+        fires = {c: dict(_zb_w_recurrence(ng, m, sig[c]))
+                 for c in (0, 1)}
+        nw = {0: 0, 1: 0}
+        ops = []
+        for t in range(T):
+            for c in (0, 1):
+                mf = t - sig[c]
+                if 0 <= mf < m:
+                    ops.append(PipeOp("F", s, mf, c))
+            # backward order lane1-then-lane0 mirrors the compiled
+            # tick (lane0's cot at device n-1 is lane1's previous dx)
+            for c in (1, 0):
+                mb = t - 2 * (ng - 1) + sig[c]
+                if 0 <= mb < m:
+                    ops.append(PipeOp("B", s, mb, c))
+            for c in (0, 1):
+                if fires[c].get(t, False):
+                    ops.append(PipeOp("W", s, nw[c], c))
+                    nw[c] += 1
+        per_stage.append(ops)
+    return Schedule("compiled-ZBVPP", n, m, per_stage, n_chunks=2,
+                    chunk_dirs=[1, -1],
+                    durations={"F": 1.0, "B": 2.0, "W": 2.0})
+
+
+def pipeline_train_zbvpp(stage_fn: Callable, stage_params,
+                         x_microbatches, last_stage_grad: Callable,
+                         head_params=None,
+                         axis_name: str = "pp",
+                         grad_dtype=jnp.float32,
+                         side_inputs=None):
+    """Zero-bubble ZB-V on the compiled ring: interleaved VPP with TWO
+    chunks in V placement + the ZBH1 dx/dW split, in ONE XLA program.
+
+    Reference being re-designed: pipeline_zero_bubble.py:151 (ZBVPP) —
+    there a pass emits B/W-split job lists per rank; here the whole
+    schedule is a lax.scan whose phases are cond-gated per device.
+
+    Placement (the 'V'): device s holds virtual stages s (lane 0,
+    forward direction) and 2n-1-s (lane 1, reverse direction). Both
+    chunk boundaries are device-local hops:
+      - vstage n-1 -> n: lane 0's output on device n-1 feeds lane 1
+        there NEXT tick (carried, no collective);
+      - vstage n's dx -> vstage n-1: lane 1's dx on device n-1 feeds
+        lane 0's backward there next tick.
+    The last virtual stage (2n-1) sits on DEVICE 0: the head/loss are
+    masked to s==0, and — since vstage 0 is also on device 0 — the
+    input cotangents dx0 never leave it. Ring traffic per tick is two
+    ppermutes: the forward ring carries (lane-0 activations, lane-1
+    cotangents), the reverse ring carries (lane-1 activations, lane-0
+    cotangents).
+
+    Grid: virtual stage sigma forwards microbatch t - sigma and
+    backwards (dx only) t - 2(2n-1) + sigma; each lane defers its
+    weight-grads into an (x, gy) stash retired by the backlog
+    recurrence with defer bound sigma (`_zb_w_recurrence`), plus
+    `zbvpp_extra_ticks` collective-free drain ticks.
+
+    Same contract as pipeline_train_1f1b except stage_params leaves
+    carry per-device leading dims [1, 2, ...]: [s][0] = vstage s
+    params, [s][1] = vstage 2n-1-s params; returned grads match. The
+    stage body must be collective-free (the ZBH1 cond-gating
+    constraint, _validate_pp_schedule). `side_inputs` follows the
+    1f1b/zbh1 contract (non-differentiated [M, ...] per-microbatch
+    values; every lane's F/B/W recompute indexes them at its own
+    microbatch — W's retire in mb order so nW is that index).
+    """
+    n = lax.axis_size(axis_name)
+    s = lax.axis_index(axis_name)
+    m = x_microbatches.shape[0]
+    ng = 2 * n
+    t_total = m + 2 * (ng - 1)
+    k0 = 2 * (ng - 1) + 1       # lane-0 F->B lag 2(2n-1-s), worst s=0
+    k1 = 2 * (n - 1) + 1        # lane-1 F->B lag 2s, worst s=n-1
+    wk0 = n + 1                 # lane-0 W backlog <= s+1 <= n
+    wk1 = ng + 1                # lane-1 W backlog <= sigma1+1 <= 2n
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+    bwd_perm = [((i + 1) % n, i) for i in range(n)]
+
+    lane_params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+    params0 = jax.tree_util.tree_map(lambda p: p[0], lane_params)
+    params1 = jax.tree_util.tree_map(lambda p: p[1], lane_params)
+    sigma1 = ng - 1 - s
+
+    def _v(x):
+        return _varying_cast(axis_name, x)
+
+    def _stage(params, x, mb_idx):
+        if side_inputs is None:
+            return stage_fn(params, x)
+        side = jax.tree_util.tree_map(lambda l: l[mb_idx], side_inputs)
+        return stage_fn(params, x, side)
+
+    head_params_v = (None if head_params is None else
+                     jax.tree_util.tree_map(_v, head_params))
+
+    x_shape = x_microbatches.shape[1:]
+    dtype = x_microbatches.dtype
+    zact = lambda: _v(jnp.zeros(x_shape, dtype))  # noqa: E731
+    grads0 = jax.tree_util.tree_map(
+        lambda p: _v(jnp.zeros(p.shape, grad_dtype)), lane_params)
+    _, _, probe_hg = last_stage_grad(jnp.zeros(x_shape, dtype),
+                                     head_params_v,
+                                     jnp.zeros((), jnp.int32))
+    head0 = None if probe_hg is None else jax.tree_util.tree_map(
+        lambda g: _v(jnp.zeros(g.shape, grad_dtype)), probe_hg)
+
+    def w_phase(lane_p, wk, nW, lane_grads, wx, wgy, fire):
+        """Retire ONE deferred weight-grad of one lane when `fire`.
+        W's retire in microbatch order, so nW is the side index."""
+        def do(g):
+            x_w = wx[jnp.mod(nW, wk)]
+            gy_w = wgy[jnp.mod(nW, wk)]
+            mb_w = jnp.clip(nW, 0, m - 1)
+            _, vjpp = jax.vjp(lambda pp: _stage(pp, x_w, mb_w), lane_p)
+            (dp,) = vjpp(gy_w)
+            return _v(jax.tree_util.tree_map(
+                lambda a, d: a + d.astype(a.dtype), g, dp))
+        lane_grads = lax.cond(fire, do, lambda g: _v(g), lane_grads)
+        return nW + jnp.where(fire, 1, 0), lane_grads
+
+    def tick(carry, t):
+        (a0_in, a1_in, c0_in, c1_in, y0_prev, dx1_prev,
+         stash0, stash1, wx0, wgy0, wx1, wgy1, nW0, nW1,
+         grads, head, loss, dx0_buf) = carry
+        g0 = jax.tree_util.tree_map(lambda g: g[0], grads)
+        g1 = jax.tree_util.tree_map(lambda g: g[1], grads)
+        # ---------------- forward lane 0 (vstage s)
+        mf0 = t - s
+        f0_active = (mf0 >= 0) & (mf0 < m)
+        mf0_c = jnp.clip(mf0, 0, m - 1)
+        x0 = jnp.where(s == 0, x_microbatches[mf0_c], a0_in)
+        y0 = lax.cond(f0_active,
+                      lambda: _v(_stage(params0, x0, mf0_c)), zact)
+        stash0 = lax.dynamic_update_index_in_dim(
+            stash0, x0, jnp.mod(t, k0), 0)
+        # ---------------- forward lane 1 (vstage 2n-1-s)
+        mf1 = t - sigma1
+        f1_active = (mf1 >= 0) & (mf1 < m)
+        mf1_c = jnp.clip(mf1, 0, m - 1)
+        x1 = jnp.where(s == n - 1, y0_prev, a1_in)
+        y1 = lax.cond(f1_active,
+                      lambda: _v(_stage(params1, x1, mf1_c)), zact)
+        stash1 = lax.dynamic_update_index_in_dim(
+            stash1, x1, jnp.mod(t, k1), 0)
+        # ---------------- head/loss: vstage 2n-1 lives on DEVICE 0
+        loss_mb, dy_seed, hgrads = last_stage_grad(
+            y1, head_params_v, jnp.clip(mf1, 0, m - 1))
+        is_head = s == 0
+        if head is not None:
+            hmask = is_head & f1_active
+            head = jax.tree_util.tree_map(
+                lambda g, d: g + jnp.where(hmask, d.astype(g.dtype), 0),
+                head, hgrads)
+        loss = loss + jnp.where(is_head & f1_active, loss_mb, 0.0)
+        # ---------------- backward lane 1 (dx only)
+        mb1 = t - 2 * (ng - 1) + sigma1
+        b1_active = (mb1 >= 0) & (mb1 < m)
+        mb1_c = jnp.clip(mb1, 0, m - 1)
+        cot1 = jnp.where(is_head, dy_seed, c1_in)
+        x_b1 = stash1[jnp.mod(t - 2 * s, k1)]
+
+        def b1_do():
+            _, vjpx = jax.vjp(
+                lambda xx: _stage(params1, xx, mb1_c), x_b1)
+            (dx,) = vjpx(cot1.astype(y1.dtype))
+            return _v(dx)
+
+        dx1 = lax.cond(b1_active, b1_do,
+                       lambda: _v(jnp.zeros(x_shape, y1.dtype)))
+        wslot1 = jnp.mod(jnp.clip(mb1, 0, m), wk1)
+        wx1, wgy1 = lax.cond(
+            b1_active,
+            lambda wx, wg: (
+                lax.dynamic_update_index_in_dim(wx, x_b1, wslot1, 0),
+                lax.dynamic_update_index_in_dim(
+                    wg, cot1.astype(dtype), wslot1, 0)),
+            lambda wx, wg: (wx, wg), wx1, wgy1)
+        # ---------------- backward lane 0 (dx only)
+        mb0 = t - 2 * (ng - 1) + s
+        b0_active = (mb0 >= 0) & (mb0 < m)
+        mb0_c = jnp.clip(mb0, 0, m - 1)
+        cot0 = jnp.where(s == n - 1, dx1_prev, c0_in)
+        x_b0 = stash0[jnp.mod(t - 2 * (ng - 1 - s), k0)]
+
+        def b0_do():
+            _, vjpx = jax.vjp(
+                lambda xx: _stage(params0, xx, mb0_c), x_b0)
+            (dx,) = vjpx(cot0.astype(y0.dtype))
+            return _v(dx)
+
+        dx0 = lax.cond(b0_active, b0_do,
+                       lambda: _v(jnp.zeros(x_shape, y0.dtype)))
+        wslot0 = jnp.mod(jnp.clip(mb0, 0, m), wk0)
+        wx0, wgy0 = lax.cond(
+            b0_active,
+            lambda wx, wg: (
+                lax.dynamic_update_index_in_dim(wx, x_b0, wslot0, 0),
+                lax.dynamic_update_index_in_dim(
+                    wg, cot0.astype(dtype), wslot0, 0)),
+            lambda wx, wg: (wx, wg), wx0, wgy0)
+        # ---------------- deferred weight-grads (backlog recurrences)
+        nB0 = jnp.clip(t - 2 * (ng - 1) + s + 1, 0, m)
+        pend0 = nB0 - nW0
+        fire0 = (pend0 > 0) & (~f0_active | (pend0 > s))
+        nW0, g0 = w_phase(params0, wk0, nW0, g0, wx0, wgy0, fire0)
+        nB1 = jnp.clip(t - 2 * (ng - 1) + sigma1 + 1, 0, m)
+        pend1 = nB1 - nW1
+        fire1 = (pend1 > 0) & (~f1_active | (pend1 > sigma1))
+        nW1, g1 = w_phase(params1, wk1, nW1, g1, wx1, wgy1, fire1)
+        grads = jax.tree_util.tree_map(
+            lambda a, b_: jnp.stack([a, b_]), g0, g1)
+        # ---------------- input cotangents: vstage 0 is on device 0
+        dx0_buf = lax.cond(
+            (s == 0) & b0_active,
+            lambda buf: lax.dynamic_update_index_in_dim(
+                buf, dx0.astype(dtype), jnp.clip(mb0, 0, m - 1), 0),
+            lambda buf: buf, dx0_buf)
+        # ---------------- hops: fwd ring (y0, dx1), bwd ring (y1, dx0)
+        a0_out = lax.ppermute(y0, axis_name, fwd_perm)
+        c1_out = lax.ppermute(dx1, axis_name, fwd_perm)
+        a1_out = lax.ppermute(y1, axis_name, bwd_perm)
+        c0_out = lax.ppermute(dx0, axis_name, bwd_perm)
+        return (a0_out, a1_out, c0_out, c1_out, y0, dx1,
+                stash0, stash1, wx0, wgy0, wx1, wgy1, nW0, nW1,
+                grads, head, loss, dx0_buf), None
+
+    carry0 = (zact(), zact(), zact(), zact(), zact(), zact(),
+              _v(jnp.zeros((k0,) + x_shape, dtype)),
+              _v(jnp.zeros((k1,) + x_shape, dtype)),
+              _v(jnp.zeros((wk0,) + x_shape, dtype)),
+              _v(jnp.zeros((wk0,) + x_shape, dtype)),
+              _v(jnp.zeros((wk1,) + x_shape, dtype)),
+              _v(jnp.zeros((wk1,) + x_shape, dtype)),
+              _v(jnp.zeros((), jnp.int32)),
+              _v(jnp.zeros((), jnp.int32)),
+              grads0,
+              head0, _v(jnp.zeros((), grad_dtype)),
+              _v(jnp.zeros((m,) + x_shape, dtype)))
+    carry, _ = lax.scan(tick, carry0, jnp.arange(t_total))
+    (_, _, _, _, _, _, _, _, wx0, wgy0, wx1, wgy1, nW0, nW1,
+     grads, head, loss, dx0_buf) = carry
+
+    # drain: retire remaining W backlogs, no collectives involved
+    n_extra = zbvpp_extra_ticks(int(n) if isinstance(n, int) else n, m)
+
+    def drain(carry, _t):
+        nW0, nW1, grads = carry
+        g0 = jax.tree_util.tree_map(lambda g: g[0], grads)
+        g1 = jax.tree_util.tree_map(lambda g: g[1], grads)
+        nW0, g0 = w_phase(params0, wk0, nW0, g0, wx0, wgy0, nW0 < m)
+        nW1, g1 = w_phase(params1, wk1, nW1, g1, wx1, wgy1, nW1 < m)
+        grads = jax.tree_util.tree_map(
+            lambda a, b_: jnp.stack([a, b_]), g0, g1)
+        return (nW0, nW1, grads), None
+
+    if n_extra > 0:
+        (nW0, nW1, grads), _ = lax.scan(
+            drain, (nW0, nW1, grads), jnp.arange(n_extra))
+
+    return _pipeline_epilogue(axis_name, s, n, loss, head, dx0_buf,
+                              grads, grad_dtype, dtype, head_stage=0)

@@ -343,3 +343,102 @@ def test_engine_pp_blocks_with_tuple_io():
                                   m.named_parameters()):
         np.testing.assert_allclose(p1.numpy(), p0.numpy(), rtol=2e-3,
                                    atol=2e-5, err_msg=n0)
+
+
+def test_engine_zero_bubble_pp_loss_parity():
+    """Engine.prepare(zero_bubble=True) at tp=1/pp=2 compiles the
+    generic-model pipeline onto the ZBH1 dx/dW-split ring — loss and
+    updated params match the single-device oracle exactly as the 1F1B
+    engine does. With tp>1 the knob is ignored (1f1b)."""
+    import jax
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.auto_parallel.engine import Engine
+    from paddle_tpu.distributed.planner import PlanCandidate
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 256, (8, 16))
+
+    m0, loss_fn = _llama_pieces()
+    opt0 = paddle.optimizer.SGD(0.05, parameters=m0.parameters())
+    loss_ref = loss_fn(m0(paddle.to_tensor(ids)), paddle.to_tensor(ids))
+    loss_ref.backward()
+    opt0.step()
+    opt0.clear_grad()
+
+    m, loss_fn = _llama_pieces()
+    opt = paddle.optimizer.SGD(0.05, parameters=m.parameters())
+    eng = Engine(model=m, loss=loss_fn, optimizer=opt)
+    plan = PlanCandidate(dp=1, tp=1, pp=2, microbatches=4)
+    eng.prepare(global_batch=8, plan=plan, zero_bubble=True)
+    assert eng._partition.pp_schedule == "zbh1"
+    with eng._mesh:
+        loss = eng._step(eng._shard_batch(ids), eng._shard_batch(ids))
+
+    np.testing.assert_allclose(float(loss._data), float(loss_ref),
+                               rtol=2e-4)
+    for (n0, p0), (n1, p1) in zip(m0.named_parameters(),
+                                  m.named_parameters()):
+        np.testing.assert_allclose(p1.numpy(), p0.numpy(), rtol=2e-3,
+                                   atol=2e-5, err_msg=n0)
+
+    # tp>1 plans fall back to 1f1b rather than refusing
+    m2, loss_fn2 = _llama_pieces()
+    opt2 = paddle.optimizer.SGD(0.05, parameters=m2.parameters())
+    eng2 = Engine(model=m2, loss=loss_fn2, optimizer=opt2)
+    eng2.prepare(global_batch=8,
+                 plan=PlanCandidate(dp=1, tp=2, pp=2, microbatches=4),
+                 zero_bubble=True)
+    assert eng2._partition.pp_schedule == "1f1b"
+
+
+def test_engine_zbvpp_pp_loss_parity():
+    """Engine.prepare(zero_bubble="zbvpp") at tp=1/pp=2 on a 4-layer
+    llama: the partitioner V-gathers the block chain into [pp, 2, Lc]
+    virtual chunks, trains on the compiled ZB-V ring, and the inverse
+    gather writes grads back to the right layers — loss and updated
+    params match the single-device oracle."""
+    import jax
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.distributed.auto_parallel.engine import Engine
+    from paddle_tpu.distributed.planner import PlanCandidate
+
+    cfg4 = LlamaConfig(vocab_size=256, hidden_size=64,
+                       intermediate_size=128, num_layers=4,
+                       num_heads=4, num_kv_heads=2, max_seq_len=64)
+    ce = nn.CrossEntropyLoss()
+
+    def loss_fn(logits, labels):
+        return ce(logits[:, :-1].reshape([-1, logits.shape[-1]]),
+                  labels[:, 1:].reshape([-1]))
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 256, (8, 16))
+
+    paddle.seed(0)
+    m0 = LlamaForCausalLM(cfg4)
+    opt0 = paddle.optimizer.SGD(0.05, parameters=m0.parameters())
+    loss_ref = loss_fn(m0(paddle.to_tensor(ids)), paddle.to_tensor(ids))
+    loss_ref.backward()
+    opt0.step()
+    opt0.clear_grad()
+
+    paddle.seed(0)
+    m = LlamaForCausalLM(cfg4)
+    opt = paddle.optimizer.SGD(0.05, parameters=m.parameters())
+    eng = Engine(model=m, loss=loss_fn, optimizer=opt)
+    plan = PlanCandidate(dp=1, tp=1, pp=2, microbatches=4)
+    eng.prepare(global_batch=8, plan=plan, zero_bubble="zbvpp")
+    assert eng._partition.pp_schedule == "zbvpp"
+    with eng._mesh:
+        loss = eng._step(eng._shard_batch(ids), eng._shard_batch(ids))
+
+    np.testing.assert_allclose(float(loss._data), float(loss_ref),
+                               rtol=2e-4)
+    for (n0, p0), (n1, p1) in zip(m0.named_parameters(),
+                                  m.named_parameters()):
+        np.testing.assert_allclose(p1.numpy(), p0.numpy(), rtol=2e-3,
+                                   atol=2e-5, err_msg=n0)

@@ -1,0 +1,212 @@
+"""Compiled zero-bubble ZB-V / ZBVPP (VERDICT r3 item 3, second half;
+reference pipeline_zero_bubble.py:151): two V-placed model chunks per
+device with dx/dW-split cond-gated backward, in ONE XLA program.
+
+Covers: numerical parity against a plain sequential autodiff oracle
+(loss, per-virtual-stage grads in V layout, head grads, input
+cotangents), schedule-equivalence of the compiled timeline against the
+dependency simulator (chunk_dirs=[1,-1]), bubble/makespan below the
+lockstep fused interleaved-VPP accounting, drain coverage of the W
+backlog, engine wiring (pp_schedule='zbvpp' loss parity with 1f1b and
+eval relayout parity), and the collective-free-stage guard."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.parallel.pipeline_1f1b import (
+    compiled_zbvpp_schedule, pipeline_train_zbvpp, zbvpp_extra_ticks)
+
+
+def _run_zbv(n, m, seed=0, hidden=8):
+    """Tiny tanh-stage V pipeline on an n-device mesh vs a sequential
+    oracle over the same 2n virtual stages. Returns (got, want) where
+    each is (loss, per-vstage grads in V layout, head grads, dx0)."""
+    ng = 2 * n
+    mesh = Mesh(np.array(jax.devices()[:n]), ("pp",))
+    rng = np.random.RandomState(seed)
+    Wv = jnp.asarray(rng.randn(ng, hidden, hidden).astype(np.float32))
+    xs = jnp.asarray(rng.randn(m, 2, hidden).astype(np.float32))
+    tgt = jnp.asarray(rng.randn(m, 2, hidden).astype(np.float32))
+    hw = jnp.asarray(rng.randn(hidden, hidden).astype(np.float32))
+
+    def stage_fn(w, x):
+        return jnp.tanh(x @ w)
+
+    def last_grad(y, hp, mb):
+        def head_loss(hp_, y_):
+            return jnp.mean((y_ @ hp_ - tgt[mb]) ** 2) / m
+        l, (ghp, gy) = jax.value_and_grad(
+            head_loss, argnums=(0, 1))(hp, y)
+        return l, gy, ghp
+
+    # V placement: device s holds [W[s], W[2n-1-s]]
+    vidx = np.stack([np.arange(n), ng - 1 - np.arange(n)], axis=1)
+    Wz = Wv[vidx]                                   # [n, 2, h, h]
+    with mesh:
+        loss, grads, hgrads, dx0 = shard_map(
+            lambda W_, xs_, hw_: pipeline_train_zbvpp(
+                stage_fn, W_, xs_, last_grad, head_params=hw_),
+            mesh=mesh, axis_names={"pp"},
+            in_specs=(P("pp"), P(None), P(None)),
+            out_specs=(P(), P("pp"), P(), P(None)))(Wz, xs, hw)
+
+    def ref_loss(Wv_, hw_, xs_):
+        total = 0.0
+        for i in range(m):
+            h = xs_[i]
+            for sig in range(ng):
+                h = jnp.tanh(h @ Wv_[sig])
+            total = total + jnp.mean((h @ hw_ - tgt[i]) ** 2) / m
+        return total
+
+    rl, (rgW, rghw, rgx) = jax.value_and_grad(
+        ref_loss, argnums=(0, 1, 2))(Wv, hw, xs)
+    return (loss, np.asarray(grads), np.asarray(hgrads),
+            np.asarray(dx0)), (rl, np.asarray(rgW), np.asarray(rghw),
+                               np.asarray(rgx))
+
+
+@pytest.mark.parametrize("n,m", [(2, 4), (4, 8)])
+def test_zbvpp_grads_match_sequential_oracle(n, m):
+    (loss, gz, hg, d0), (rl, rgW, rghw, rgx) = _run_zbv(n, m)
+    ng = 2 * n
+    np.testing.assert_allclose(float(loss), float(rl), rtol=1e-5)
+    for s in range(n):
+        np.testing.assert_allclose(gz[s, 0], rgW[s],
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(gz[s, 1], rgW[ng - 1 - s],
+                                   rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(hg, rghw, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(d0, rgx, rtol=1e-4, atol=1e-5)
+
+
+def test_compiled_zbv_timeline_is_valid_and_complete():
+    """Schedule equivalence: the exact compiled timeline simulates
+    without deadlock under the V-placement dependency chain
+    (chunk_dirs=[1,-1]) and contains every per-chunk F/B/W cell exactly
+    once."""
+    for n, m in [(2, 4), (4, 8), (4, 4), (3, 6)]:
+        sched = compiled_zbvpp_schedule(n, m)
+        assert sched.chunk_dirs == [1, -1]
+        sched.simulate()                    # raises if invalid
+        for s in range(n):
+            for kind in "FBW":
+                for c in (0, 1):
+                    mbs = sorted(op.mb for op in sched.per_stage[s]
+                                 if op.kind == kind and op.chunk == c)
+                    assert mbs == list(range(m)), (s, kind, c, mbs)
+
+
+def test_zbvpp_bubble_beats_lockstep_interleaved():
+    """The cond-gated ZB-V timeline's bubble and makespan are below the
+    lockstep fused interleaved-VPP(v=2) accounting, whose every tick
+    costs both lanes' full F + fused-B (durations F=1, fused B=3)
+    regardless of masking."""
+    n, m = 4, 8
+    ng = 2 * n
+    zb = compiled_zbvpp_schedule(n, m)
+    mk, bubble = zb.simulate()
+    t_lockstep = (m + 2 * (ng - 1)) * 8.0      # 2F + 2 fused-B per tick
+    bubble_lockstep = 1.0 - (m * 8.0) / t_lockstep
+    assert bubble < bubble_lockstep, (bubble, bubble_lockstep)
+    assert mk < t_lockstep, (mk, t_lockstep)
+
+
+def test_zbv_extra_ticks_drain_backlog():
+    for n, m in [(2, 2), (2, 4), (4, 4), (3, 3)]:
+        e = zbvpp_extra_ticks(n, m)
+        assert e >= 0
+        sched = compiled_zbvpp_schedule(n, m)
+        for s in range(n):
+            assert sum(1 for op in sched.per_stage[s]
+                       if op.kind == "W") == 2 * m
+
+
+def test_engine_zbvpp_loss_parity():
+    """pp_schedule='zbvpp' through the hybrid engine: same loss curve
+    as 1f1b (which itself matches single-device)."""
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.models import gpt_hybrid as GH
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=4,
+                    num_heads=2, max_seq_len=32)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 128, (4, 32)))
+
+    losses = {}
+    for sched in ("1f1b", "zbvpp"):
+        pcfg = GH.ParallelConfig(dp=1, pp=2, tp=1, microbatches=2,
+                                 pp_schedule=sched, remat=True)
+        mesh, params, opt, step = GH.setup(cfg, pcfg, seed=0,
+                                           devices=jax.devices()[:2])
+        with mesh:
+            curve = []
+            for _ in range(4):
+                params, opt, loss = step(params, opt, (ids, ids))
+                curve.append(float(loss))
+        losses[sched] = curve
+    np.testing.assert_allclose(losses["1f1b"], losses["zbvpp"],
+                               rtol=2e-5)
+
+
+def test_engine_zbvpp_eval_relayout_parity():
+    """forward_hidden under the ZB-V [pp, 2, Lc] stacking matches the
+    pp=1 forward on identical weights (the eval relayout gathers the
+    virtual stages back into layer order)."""
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.models import gpt_hybrid as GH
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=4,
+                    num_heads=2, max_seq_len=32)
+    ids = jnp.asarray(np.random.RandomState(1).randint(0, 128, (2, 32)))
+
+    pcfg1 = GH.ParallelConfig(dp=1, pp=1, tp=1, remat=False,
+                              param_dtype=jnp.float32,
+                              compute_dtype=jnp.float32)
+    mesh1 = GH.build_mesh(pcfg1, jax.devices()[:1])
+    params = GH.init_params(cfg, pcfg1, jax.random.PRNGKey(0))
+    with mesh1:
+        want = np.asarray(GH.forward_hidden(params, ids, cfg, pcfg1,
+                                            mesh1))
+
+    # f32 end-to-end: XLA:CPU's AllReducePromotion CHECK-crashes on the
+    # bf16 psum this eval path would otherwise emit (NOTES gotcha)
+    pcfgv = GH.ParallelConfig(dp=1, pp=2, tp=1, microbatches=2,
+                              pp_schedule="zbvpp", remat=False,
+                              param_dtype=jnp.float32,
+                              compute_dtype=jnp.float32)
+    meshv = GH.build_mesh(pcfgv, jax.devices()[:2])
+    paramsv, _ = GH.shard_params(
+        jax.tree_util.tree_map(lambda x: x, params), meshv, cfg, pcfgv)
+    with meshv:
+        got = np.asarray(GH.forward_hidden(paramsv, ids, cfg, pcfgv,
+                                           meshv))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_zbvpp_rejects_collective_stage_bodies_and_bad_layers():
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.models import gpt_hybrid as GH
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=4,
+                    num_heads=2, max_seq_len=16)
+    pcfg = GH.ParallelConfig(dp=1, pp=2, tp=2, microbatches=2,
+                             pp_schedule="zbvpp")
+    with pytest.raises(ValueError, match="collective-free"):
+        GH.build_train_step(cfg, pcfg, None)
+    # pp=1 has no ring for the V placement
+    with pytest.raises(ValueError, match="pp > 1"):
+        GH.build_train_step(
+            cfg, GH.ParallelConfig(dp=1, pp=1, pp_schedule="zbvpp"),
+            None)
+    # layers must split 2*pp ways
+    cfg6 = GPTConfig(vocab_size=64, hidden_size=32, num_layers=6,
+                     num_heads=2, max_seq_len=16)
+    pcfg6 = GH.ParallelConfig(dp=1, pp=2, tp=1, microbatches=2,
+                              pp_schedule="zbvpp")
+    mesh = GH.build_mesh(pcfg6, jax.devices()[:2])
+    params = GH.init_params(cfg6, pcfg6, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="2\\*pp"):
+        GH.shard_params(params, mesh, cfg6, pcfg6)
