@@ -166,10 +166,13 @@ def masked_multihead_attention_impl(x, cache_kv, seq_lens, num_heads,
                   n_outputs=2, differentiable=False)
 
 
-def _sample(logits, key, temperature, top_p):
-    """On-device sampling: greedy / temperature / nucleus."""
+def _sample(logits, key, temperature, top_p, top_k=None):
+    """On-device sampling: greedy / temperature / top-k / nucleus."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), key
+    if top_k is not None and top_k > 0:
+        kth = lax.top_k(logits, int(top_k))[0][..., -1:]
+        logits = jnp.where(logits >= kth, logits, -jnp.inf)
     probs = jax.nn.softmax(logits.astype(jnp.float32) / temperature, -1)
     if top_p is not None and top_p < 1.0:
         sorted_p = jnp.sort(probs, axis=-1)[..., ::-1]
@@ -206,8 +209,8 @@ class DecodeSession:
     """
 
     def __init__(self, model, max_length, prefill_buckets=None,
-                 temperature=0.0, top_p=None, eos_token_id=None,
-                 decode_block=None):
+                 temperature=0.0, top_p=None, top_k=None,
+                 eos_token_id=None, decode_block=None):
         model.eval()
         self._model = model
         self._max_length = int(max_length)
@@ -215,6 +218,7 @@ class DecodeSession:
                                _default_buckets(self._max_length))
         self._temperature = float(temperature)
         self._top_p = top_p
+        self._top_k = top_k
         self._eos = eos_token_id
         self._buckets = [min(b, self._max_length) for b in self._buckets]
         self._state = self._collect_state()
@@ -294,7 +298,8 @@ class DecodeSession:
         # last VALID position's logits, per sequence
         b = ids.shape[0]
         last = logits[jnp.arange(b), lens - 1]
-        nxt, key = _sample(last, key, self._temperature, self._top_p)
+        nxt, key = _sample(last, key, self._temperature, self._top_p,
+                           self._top_k)
         # prefill wrote the full padded block: reset lengths to the true
         # prompt lengths (padding slots get overwritten by decode steps).
         # The length leaf is located structurally via the cache treedef,
@@ -312,7 +317,7 @@ class DecodeSession:
         logits, cache_out = self._run_model(state, token[:, None],
                                             cache_arrays)
         nxt, key = _sample(logits[:, -1], key, self._temperature,
-                           self._top_p)
+                           self._top_p, self._top_k)
         return nxt, key, cache_out
 
     def _decode_block_pure(self, *flat):
@@ -343,7 +348,7 @@ class DecodeSession:
             logits, cache_out = self._run_model(state, token[:, None],
                                                 caches)
             nxt, key = _sample(logits[:, -1], key, self._temperature,
-                               self._top_p)
+                               self._top_p, self._top_k)
             if eos is not None:
                 nxt = jnp.where(fin, jnp.int32(eos), nxt)
                 fin = fin | (nxt == eos)

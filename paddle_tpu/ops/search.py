@@ -212,7 +212,17 @@ def unique_consecutive(x, return_inverse=False, return_counts=False,
 
 
 def index_of(x, value):
-    raise NotImplementedError
+    """First flat index of `value` in `x` (list.index semantics over
+    the flattened tensor; the host-side search helper the schema table
+    reserves). Returns an int64 scalar Tensor; raises ValueError when
+    the value is absent — same contract as python's list.index, which
+    is the surface this helper mirrors."""
+    arr = np.asarray(x._data).reshape(-1)
+    hits = np.nonzero(arr == value)[0]
+    if hits.size == 0:
+        raise ValueError(f"{value!r} is not in tensor")
+    return Tensor._wrap(jnp.asarray(hits[0],
+                                    dtype_mod.jax_dtype("int64")))
 
 
 def histogram(input, bins=100, min=0, max=0, weight=None, density=False,

@@ -149,15 +149,28 @@ class FasterTokenizer(Layer):
             [None] * len(texts)
         rows, types = [], []
         for t, p in zip(texts, pairs):
-            ids = [self.cls_id] + self._encode(t) + [self.sep_id]
+            a = self._encode(t)
+            b = self._encode(p) if p is not None else None
+            if max_seq_len:
+                # longest-first pairwise truncation (reference
+                # BertTokenizer::TruncateSequence,
+                # faster_tokenizer_op.cc:294): pop from the longer
+                # sequence until CLS + a + SEP (+ b + SEP) fits
+                budget = max(max_seq_len - (3 if b is not None else 2),
+                             0)
+                over = len(a) + (len(b) if b is not None else 0) - budget
+                for _ in range(min(max(over, 0),
+                                   len(a) + len(b or []))):
+                    if not b or len(a) > len(b):
+                        a.pop()
+                    else:
+                        b.pop()
+            ids = [self.cls_id] + a + [self.sep_id]
             tt = [0] * len(ids)
-            if p is not None:
-                second = self._encode(p) + [self.sep_id]
+            if b is not None:
+                second = b + [self.sep_id]
                 ids += second
                 tt += [1] * len(second)
-            if max_seq_len and len(ids) > max_seq_len:
-                ids = ids[:max_seq_len - 1] + [self.sep_id]
-                tt = tt[:max_seq_len]
             rows.append(ids)
             types.append(tt)
         width = max(len(r) for r in rows)

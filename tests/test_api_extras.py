@@ -256,3 +256,14 @@ class TestDynamicShapeExport:
             np.testing.assert_allclose(
                 tl(paddle.to_tensor(x)).numpy(),
                 lin(paddle.to_tensor(x)).numpy(), rtol=1e-5)
+
+
+class TestIndexOf:
+    def test_first_flat_hit_and_missing(self):
+        from paddle_tpu.ops.search import index_of
+        x = paddle.to_tensor(np.array([[3, 1], [2, 1]], np.int64))
+        assert int(index_of(x, 1)) == 1          # first flat occurrence
+        assert int(index_of(x, 2)) == 2
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="not in tensor"):
+            index_of(x, 9)

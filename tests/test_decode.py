@@ -315,3 +315,23 @@ def test_block_decode_eos_early_exit(tiny_llama):
     assert (gen[hit:] == eos).all(), gen
     np.testing.assert_array_equal(ref[1], out[1])
     assert sess.executable_counts()[1] == 1
+
+
+def test_decode_session_top_k_restricts_support(tiny_llama):
+    """top_k sampling: every sampled token lies in the top-k of the
+    step's logits (checked via a k=1 session equaling greedy)."""
+    from paddle_tpu.inference.decode import DecodeSession
+    m = tiny_llama
+    paddle.seed(3)
+    ids = paddle.randint(0, 256, [2, 6])
+    greedy = DecodeSession(m, 32).generate(
+        ids, max_new_tokens=5).numpy()
+    # temperature>0 but k=1 collapses the support to the argmax
+    k1 = DecodeSession(m, 32, temperature=1.0, top_k=1).generate(
+        ids, max_new_tokens=5, seed=11).numpy()
+    np.testing.assert_array_equal(greedy, k1)
+    # k=5 with a seed reproduces itself
+    s = DecodeSession(m, 32, temperature=0.9, top_k=5)
+    a = s.generate(ids, max_new_tokens=5, seed=7).numpy()
+    b = s.generate(ids, max_new_tokens=5, seed=7).numpy()
+    np.testing.assert_array_equal(a, b)

@@ -80,3 +80,32 @@ def test_string_tensor_input_to_tokenizer():
     st = StringTensor(["hello world", "the un"])
     ids, _ = tok(st)
     assert ids.shape[0] == 2
+
+
+def test_faster_tokenizer_longest_first_pair_truncation():
+    """Pairwise truncation pops from the LONGER sequence (reference
+    BertTokenizer::TruncateSequence, faster_tokenizer_op.cc:294) —
+    the shorter side survives intact instead of both being tail-cut."""
+    tok = FasterTokenizer(VOCAB)
+    long_text = "hello world the un hello world the un"
+    ids, tt = tok([long_text], text_pair=["un"], max_seq_len=8)
+    row = ids.numpy()[0].tolist()
+    # CLS + 4 first-seq tokens + SEP + "un" + SEP = exactly 8
+    assert len(row) == 8
+    assert row[0] == VOCAB.index("[CLS]")
+    # the short pair ("un") must survive: exactly one token of type 1
+    # before the final SEP
+    t = tt.numpy()[0].tolist()
+    assert sum(t) == 2            # "un" + its SEP carry type 1
+    sep = tok.sep_id
+    assert row[-1] == sep and row.count(sep) == 2
+
+
+def test_faster_tokenizer_tiny_max_seq_len_no_crash():
+    """max_seq_len below the special-token overhead must not crash
+    (regression: longest-first truncation popped from empty lists)."""
+    tok = FasterTokenizer(VOCAB)
+    ids, tt = tok(["hello world the"], text_pair=["un"], max_seq_len=2)
+    assert ids.shape[0] == 1
+    ids2, _ = tok(["hello world the"], max_seq_len=1)
+    assert ids2.shape[0] == 1
