@@ -171,6 +171,12 @@ class FasterTokenizer(Layer):
                 second = b + [self.sep_id]
                 ids += second
                 tt += [1] * len(second)
+            if max_seq_len:
+                # hard length contract: never exceed max_seq_len, even
+                # when it is below the special-token overhead (the
+                # longest-first pops above already fit normal cases, so
+                # this clamp only bites the degenerate ones)
+                ids, tt = ids[:max_seq_len], tt[:max_seq_len]
             rows.append(ids)
             types.append(tt)
         width = max(len(r) for r in rows)

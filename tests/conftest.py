@@ -35,3 +35,38 @@ def _seeded():
     paddle_tpu.seed(2024)
     np.random.seed(2024)
     yield
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _fresh_dirty_state_log():
+    # one log per session: stale entries from earlier runs would point
+    # the 'first leaker' diagnostic at the wrong test
+    try:
+        os.remove("/tmp/jax_dirty_state.log")
+    except OSError:
+        pass
+    yield
+
+
+@pytest.fixture(autouse=True)
+def _jax_global_state_hygiene(request):
+    """Record the FIRST test that leaves process-global jax state dirty
+    (leaked disable_jit / trace context / x64): such a leak silently
+    degrades every later test — the executable-count perf gate caught
+    one as an order-dependent failure. Diagnostic log only; the leaker
+    is fixed at the source."""
+    yield
+    from jax._src import core as _jcore
+    dirty = []
+    if jax.config.jax_disable_jit:
+        dirty.append("jax_disable_jit")
+    if jax.config.jax_enable_x64:
+        dirty.append("jax_enable_x64")
+    try:
+        if not _jcore.trace_state_clean():
+            dirty.append("trace_state")
+    except Exception:
+        pass
+    if dirty:
+        with open("/tmp/jax_dirty_state.log", "a") as f:
+            f.write(f"{request.node.nodeid}: {dirty}\n")

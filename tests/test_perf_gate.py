@@ -85,6 +85,19 @@ def test_flagship_state_bytes_within_budget():
 
 
 def test_train_step_executable_count_stable():
+    """Steady-state calls of the jitted train step must neither
+    RE-TRACE nor RE-COMPILE (a recompile = silent 20-40 s/step cliff).
+
+    Asserted via jax's own event counters over calls 2..4, NOT via
+    PjitFunction._cache_size(): the C++ fastpath-cache entry count
+    measures whether jaxlib *installed its dispatch fastpath*, which
+    late in a long test session can legitimately be declined (observed
+    deterministically after ~750 suite tests with zero retraces, zero
+    recompiles, clean config and an effect-free jaxpr — a jaxlib
+    dispatch-layer heuristic, not a program regression). Counting
+    actual tracing/compilation events pins the invariant that matters
+    and is order-independent."""
+    from jax._src import test_util as jtu
     cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
                     num_heads=2, max_seq_len=64)
     pcfg = _flagship_pcfg(param_dtype=jnp.float32,
@@ -93,42 +106,25 @@ def test_train_step_executable_count_stable():
                                              devices=jax.devices()[:1])
     ids = jnp.zeros((2, 32), jnp.int32)
     with mesh:
-        for _ in range(3):
-            params, opt_state, loss = step(params, opt_state, (ids, ids))
-    n = step._cache_size()
-    if n != 1:
-        # self-diagnosis for the (so-far order-dependent, full-suite-
-        # only) failure: re-run the loop with cache-miss explanations
-        # on so the captured log names WHAT differed between calls
-        import logging
-        diag = logging.getLogger("jax._src.interpreters.pxla")
-        records = []
-        h = logging.Handler()
-        h.emit = lambda r: records.append(r.getMessage())
-        for lg in ("jax._src.interpreters.pxla", "jax._src.pjit",
-                   "jax._src.dispatch"):
-            logging.getLogger(lg).addHandler(h)
-            logging.getLogger(lg).setLevel(logging.DEBUG)
-        try:
-            jax.config.update("jax_explain_cache_misses", True)
-            with mesh:
-                for _ in range(3):
-                    params, opt_state, loss = step(
-                        params, opt_state, (ids, ids))
-            n2 = step._cache_size()
-        finally:
-            jax.config.update("jax_explain_cache_misses", False)
-            for lg in ("jax._src.interpreters.pxla", "jax._src.pjit",
-                       "jax._src.dispatch"):
-                logging.getLogger(lg).removeHandler(h)
-        explain = "\n".join(records[-20:])
-        raise AssertionError(
-            f"train step compiled {n} executables for one shape "
-            f"(re-probe: {n2}) — donation/weak-type drift is forcing "
-            f"recompiles.\nconfig: x64={jax.config.jax_enable_x64} "
-            f"debug_nans={jax.config.jax_debug_nans} "
-            f"matmul={jax.config.jax_default_matmul_precision}\n"
-            f"cache-miss explanations:\n{explain}")
+        # warmup call pays the one allowed trace+compile
+        params, opt_state, loss = step(params, opt_state, (ids, ids))
+        with jtu.count_jit_tracing_cache_miss() as traces, \
+                jtu.count_jit_compilation_cache_miss() as compiles:
+            for _ in range(3):
+                params, opt_state, loss = step(params, opt_state,
+                                               (ids, ids))
+    assert traces() == 0 and compiles() == 0, (
+        f"steady-state train-step calls re-traced {traces()}x / "
+        f"re-compiled {compiles()}x — donation/weak-type/sharding "
+        "drift is forcing recompiles")
+    # liveness: the counters must SEE a genuine recompile (new shape),
+    # or the zero above proves nothing
+    with mesh:
+        with jtu.count_jit_tracing_cache_miss() as traces2:
+            ids2 = jnp.zeros((4, 32), jnp.int32)
+            params, opt_state, loss = step(params, opt_state,
+                                           (ids2, ids2))
+    assert traces2() > 0, "counter failed to observe a real retrace"
 
 
 def test_gradient_merge_accumulator_dtype():
