@@ -37,12 +37,16 @@ def _seeded():
     yield
 
 
+# one log per session (pid-suffixed: concurrent sessions/users must not
+# clobber each other's 'first leaker' diagnostic or hit foreign-owned
+# /tmp files in fixture teardown)
+DIRTY_STATE_LOG = f"/tmp/jax_dirty_state.{os.getpid()}.log"
+
+
 @pytest.fixture(autouse=True, scope="session")
 def _fresh_dirty_state_log():
-    # one log per session: stale entries from earlier runs would point
-    # the 'first leaker' diagnostic at the wrong test
     try:
-        os.remove("/tmp/jax_dirty_state.log")
+        os.remove(DIRTY_STATE_LOG)
     except OSError:
         pass
     yield
@@ -68,5 +72,5 @@ def _jax_global_state_hygiene(request):
     except Exception:
         pass
     if dirty:
-        with open("/tmp/jax_dirty_state.log", "a") as f:
+        with open(DIRTY_STATE_LOG, "a") as f:
             f.write(f"{request.node.nodeid}: {dirty}\n")
