@@ -40,7 +40,9 @@ from paddle_tpu.models import gpt_hybrid as GH
 
 cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=4,
                 num_heads=4, max_seq_len=16)
-pcfg = GH.ParallelConfig(dp=2, pp=2, tp=2, sp=True, microbatches=2,
+num_experts = int(os.environ.get("MP_TRAIN_EXPERTS", "0"))
+pcfg = GH.ParallelConfig(dp=2, pp=2, tp=2, sp=num_experts == 0,
+                         num_experts=num_experts, microbatches=2,
                          pp_schedule="1f1b", remat=True,
                          param_dtype=jnp.float32,
                          compute_dtype=jnp.float32)
@@ -78,7 +80,15 @@ def _free_port():
     return port
 
 
-def test_two_process_hybrid_train_matches_single_process(tmp_path):
+import pytest
+
+
+@pytest.mark.parametrize("experts", [0, 4])
+def test_two_process_hybrid_train_matches_single_process(tmp_path,
+                                                         experts):
+    """experts=0: dense + Megatron-SP. experts=4: EP-over-dp MoE — the
+    GShard all-to-all dispatch crosses the process boundary (the
+    reference's multi-node global_scatter/gather over NCCL)."""
     # single-process oracle on the same 8 virtual devices
     import jax
     import jax.numpy as jnp
@@ -87,7 +97,8 @@ def test_two_process_hybrid_train_matches_single_process(tmp_path):
 
     cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=4,
                     num_heads=4, max_seq_len=16)
-    pcfg = GH.ParallelConfig(dp=2, pp=2, tp=2, sp=True, microbatches=2,
+    pcfg = GH.ParallelConfig(dp=2, pp=2, tp=2, sp=experts == 0,
+                             num_experts=experts, microbatches=2,
                              pp_schedule="1f1b", remat=True,
                              param_dtype=jnp.float32,
                              compute_dtype=jnp.float32)
@@ -106,6 +117,7 @@ def test_two_process_hybrid_train_matches_single_process(tmp_path):
     env = dict(os.environ)
     env["PYTHONPATH"] = "/root/repo"
     env["MARKER_DIR"] = str(tmp_path)
+    env["MP_TRAIN_EXPERTS"] = str(experts)
     # each worker provisions its own 4-device CPU backend (force_cpu)
     env.pop("XLA_FLAGS", None)
     port = _free_port()
