@@ -78,6 +78,14 @@ class Schedule:
             order += [(s_, c) for s_ in rng]
         return order
 
+    def virtual_index(self, stage: int, chunk: int) -> int:
+        """Depth of (stage, chunk) in the virtual-stage chain, honoring
+        per-chunk traversal direction — the index callback authors use
+        to pick the right weights (round-robin placements: chunk*n +
+        stage; the ZB-V placement: stage for chunk 0, 2n-1-stage for
+        chunk 1)."""
+        return self._chain_pos[(stage, chunk)]
+
     def deps(self, op: PipeOp) -> List[PipeOp]:
         """Cross-stage + intra-cell dependencies of one cell."""
         chain = self._chain_list
@@ -274,9 +282,13 @@ def run_schedule(sched: Schedule, forward: Callable, backward: Callable,
         grads; required for zero-bubble schedules with W cells. For
         schedules without W cells pass None and fold weight grads into
         `backward`; mismatches in either direction raise.)
-    microbatch_inputs: list of M inputs to (stage0, chunk0)
-    loss_grads: list of M output-cotangents seeded at the last virtual
-        stage (stage n-1, chunk v-1)
+    microbatch_inputs: list of M inputs to the FIRST virtual stage
+        (chain position 0 — (stage 0, chunk 0) for every placement)
+    loss_grads: list of M output-cotangents seeded at the LAST virtual
+        stage — (stage n-1, chunk v-1) for round-robin placements,
+        (stage 0, chunk 1) under the ZB-V placement (chunk_dirs
+        [1,-1]); `Schedule.virtual_index` maps (stage, chunk) to chain
+        depth for callback authors
 
     Executes cells in a valid global order (round-robin over stages
     honoring per-stage order + readiness, like the simulator). Returns
@@ -297,7 +309,13 @@ def run_schedule(sched: Schedule, forward: Callable, backward: Callable,
     ctxs: Dict[Tuple[int, int, int], object] = {}
     grads: Dict[Tuple[int, int, int], object] = {}  # B input-grads
     outs: Dict[int, object] = {}
-    n, v = sched.n_stages, sched.n_chunks
+    n = sched.n_stages
+    # data routing follows the virtual-stage CHAIN (which encodes
+    # chunk_dirs), not hard-coded stage-0/stage-(n-1) boundaries — so
+    # reversed chunks (the ZB-V placement) route correctly too
+    chain = sched._chain_list
+    pos_of = sched._chain_pos
+    last = len(chain) - 1
     done = set()
     ptr = [0] * n
     total = sum(len(ops) for ops in sched.per_stage)
@@ -310,25 +328,24 @@ def run_schedule(sched: Schedule, forward: Callable, backward: Callable,
                 if any(d not in done for d in sched.deps(op)):
                     break
                 key = (op.stage, op.mb, op.chunk)
+                pos = pos_of[(op.stage, op.chunk)]
                 if op.kind == "F":
-                    if op.stage == 0 and op.chunk == 0:
+                    if pos == 0:
                         x = microbatch_inputs[op.mb]
-                    elif op.stage == 0:
-                        x = acts[(n - 1, op.mb, op.chunk - 1)]
                     else:
-                        x = acts[(op.stage - 1, op.mb, op.chunk)]
+                        ps, pc = chain[pos - 1]
+                        x = acts[(ps, op.mb, pc)]
                     y, ctx = forward(op.stage, op.chunk, x)
                     acts[key] = y
                     ctxs[key] = ctx
-                    if op.stage == n - 1 and op.chunk == v - 1:
+                    if pos == last:
                         outs[op.mb] = y
                 elif op.kind == "B":
-                    if op.stage == n - 1 and op.chunk == v - 1:
+                    if pos == last:
                         gy = loss_grads[op.mb]
-                    elif op.stage == n - 1:
-                        gy = grads[(0, op.mb, op.chunk + 1)]
                     else:
-                        gy = grads[(op.stage + 1, op.mb, op.chunk)]
+                        ns, nc = chain[pos + 1]
+                        gy = grads[(ns, op.mb, nc)]
                     gx = backward(op.stage, op.chunk, ctxs[key], gy)
                     grads[key] = gx
                     if weight_grad is not None:
