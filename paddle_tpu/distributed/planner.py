@@ -99,14 +99,22 @@ class PlanCandidate:
         the zero/microbatch/remat choices. Extra kwargs override.
 
         zero_bubble=True upgrades the pipeline schedule to the compiled
-        zero-bubble ZBH1 when the plan's stage bodies are
+        zero-bubble ZBH1; zero_bubble="zbvpp" selects the ZB-V schedule
+        (matching Engine.prepare's contract); other strings raise.
+        The upgrade applies when the plan's stage bodies are
         collective-free (tp==1 — the cond-gating constraint,
         gpt_hybrid._validate_pp_schedule); with tp>1 the knob is
         ignored (1F1B) rather than refused, so planner-driven configs
         stay runnable."""
         from paddle_tpu.models.gpt_hybrid import ParallelConfig
+        if isinstance(zero_bubble, str) and \
+                zero_bubble not in ("zbh1", "zbvpp"):
+            raise ValueError(
+                f"unrecognized zero_bubble schedule {zero_bubble!r}; "
+                "expected True, 'zbh1' or 'zbvpp'")
+        zb_sched = zero_bubble if isinstance(zero_bubble, str) else "zbh1"
         sched = "gpipe" if self.pp <= 1 else (
-            "zbh1" if zero_bubble and self.tp == 1 else "1f1b")
+            zb_sched if zero_bubble and self.tp == 1 else "1f1b")
         kw = dict(dp=self.dp, tp=self.tp, pp=self.pp, sp=self.sp,
                   microbatches=self.microbatches,
                   pp_schedule=sched,

@@ -171,7 +171,10 @@ def _sample(logits, key, temperature, top_p, top_k=None):
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), key
     if top_k is not None and top_k > 0:
-        kth = lax.top_k(logits, int(top_k))[0][..., -1:]
+        # clamp so over-large configs degrade to no-op filtering instead
+        # of a shape error deep inside the compiled step
+        kth = lax.top_k(logits,
+                        int(min(top_k, logits.shape[-1])))[0][..., -1:]
         logits = jnp.where(logits >= kth, logits, -jnp.inf)
     probs = jax.nn.softmax(logits.astype(jnp.float32) / temperature, -1)
     if top_p is not None and top_p < 1.0:

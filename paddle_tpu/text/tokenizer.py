@@ -171,12 +171,16 @@ class FasterTokenizer(Layer):
                 second = b + [self.sep_id]
                 ids += second
                 tt += [1] * len(second)
-            if max_seq_len:
+            if max_seq_len and len(ids) > max_seq_len:
                 # hard length contract: never exceed max_seq_len, even
                 # when it is below the special-token overhead (the
                 # longest-first pops above already fit normal cases, so
-                # this clamp only bites the degenerate ones)
+                # this clamp only bites the degenerate ones). Keep the
+                # terminal [SEP] contract: the last kept token becomes
+                # sep_id so consumers relying on a closing separator
+                # still see one.
                 ids, tt = ids[:max_seq_len], tt[:max_seq_len]
+                ids[-1] = self.sep_id
             rows.append(ids)
             types.append(tt)
         width = max(len(r) for r in rows)

@@ -335,3 +335,8 @@ def test_decode_session_top_k_restricts_support(tiny_llama):
     a = s.generate(ids, max_new_tokens=5, seed=7).numpy()
     b = s.generate(ids, max_new_tokens=5, seed=7).numpy()
     np.testing.assert_array_equal(a, b)
+    # top_k larger than the vocab is clamped (no shape error deep in
+    # the compiled step) and degrades to unrestricted sampling
+    big = DecodeSession(m, 32, temperature=0.9, top_k=10**6)
+    out = big.generate(ids, max_new_tokens=3, seed=7).numpy()
+    assert out.shape == (2, 9)

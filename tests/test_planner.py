@@ -168,3 +168,10 @@ def test_plan_to_parallel_config_zero_bubble_knob():
     p1 = PlanCandidate(dp=8, tp=1, pp=1)
     assert p1.to_parallel_config(
         zero_bubble=True).pp_schedule == "gpipe"
+    # the "zbvpp" string selects ZB-V (Engine.prepare's contract);
+    # unknown strings raise instead of silently degrading to zbh1
+    assert p.to_parallel_config(
+        zero_bubble="zbvpp").pp_schedule == "zbvpp"
+    import pytest
+    with pytest.raises(ValueError, match="zero_bubble"):
+        p.to_parallel_config(zero_bubble="zb2p")

@@ -110,3 +110,8 @@ def test_faster_tokenizer_tiny_max_seq_len_no_crash():
     assert ids.shape[1] <= 2          # hard length contract holds
     ids2, _ = tok(["hello world the"], max_seq_len=1)
     assert ids2.shape[0] == 1 and ids2.shape[1] <= 1
+    # terminal-SEP contract survives the degenerate clamp: the last
+    # kept token is rewritten to sep_id (legacy behavior)
+    assert int(ids2.numpy()[0, -1]) == tok.sep_id
+    ids3, _ = tok(["hello world the"], text_pair=["un"], max_seq_len=2)
+    assert int(ids3.numpy()[0, -1]) == tok.sep_id
