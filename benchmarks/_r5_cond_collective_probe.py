@@ -1,0 +1,229 @@
+"""Round-5 probe: which collectives survive inside a lax.cond branch
+whose predicate varies over 'pp' but is UNIFORM over 'tp'?
+
+Round 4 established that GSPMD-auto tp collectives inside a cond-gated
+pipeline phase deadlock (half the mesh waits in-branch, half at the
+ring permute) — hence the zero-bubble collective-free-stage constraint.
+This probe separates the failure axes:
+
+  A. manual shard_map over {'pp','tp'}, EXPLICIT lax.psum('tp') inside
+     the cond branch (tp-uniform predicate) + ppermute('pp') per tick
+  B. manual over {'pp'} only, tp GSPMD-auto inside: a tp-sharded
+     matmul inside the cond branch (the round-4 configuration)
+  C. control: same as A with the psum hoisted OUT of the cond
+
+Each leg runs under a hard alarm; a leg that trips the alarm is
+recorded as DEADLOCK rather than hanging the probe.
+"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax._src.xla_bridge as xb
+xb._backend_factories.pop("axon", None)
+xb._backend_factories.pop("tpu", None)
+_f = xb._get_backend_uncached
+if getattr(_f, "__name__", "") == "_axon_get_backend_uncached" \
+        and _f.__closure__:
+    xb._get_backend_uncached = _f.__closure__[0].cell_contents
+
+import signal
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class Alarm(Exception):
+    pass
+
+
+def _with_alarm(fn, seconds=60):
+    def handler(signum, frame):
+        raise Alarm()
+    old = signal.signal(signal.SIGALRM, handler)
+    signal.alarm(seconds)
+    try:
+        return fn()
+    except Alarm:
+        return "DEADLOCK(alarm)"
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+devs = np.array(jax.devices()[:4]).reshape(2, 2)
+mesh = Mesh(devs, ("pp", "tp"))
+H = 8
+
+
+def _v(axes, x):
+    vma = getattr(jax.typeof(x), "vma", frozenset())
+    need = tuple(a for a in axes if a not in vma)
+    return lax.pcast(x, need, to="varying") if need else x
+
+
+def leg_a():
+    """Manual tp, explicit psum INSIDE cond (tp-uniform predicate).
+    Row-parallel matmul: x sliced on cols locally, w row-shard local,
+    partial product psum'd over tp in-branch."""
+    def body(x, w):
+        s = lax.axis_index("pp")
+        tix = lax.axis_index("tp")
+
+        def tick(c, t):
+            def active():
+                xl = lax.dynamic_slice_in_dim(c, tix * (H // 2),
+                                              H // 2, 1)
+                part = xl @ w                     # local shard matmul
+                # psum over tp in-branch; cast back to tp-varying so
+                # both branches carry the same vma type
+                return _v(("pp", "tp"), lax.psum(part, "tp"))
+
+            def idle():
+                return _v(("pp", "tp"), jnp.zeros((H, H), c.dtype))
+
+            y = lax.cond((t - s) >= 0, active, idle)
+            y = lax.ppermute(y, "pp",
+                             [(i, (i + 1) % 2) for i in range(2)])
+            return y, None
+
+        x = _v(("pp", "tp"), x)
+        out, _ = lax.scan(tick, x, jnp.arange(4))
+        return lax.psum(out, ("pp", "tp")) / 4
+
+    x = jnp.ones((H, H), jnp.float32)
+    w = jnp.ones((H, H), jnp.float32)
+    fn = jax.jit(shard_map(
+        body, mesh=mesh, axis_names={"pp", "tp"},
+        in_specs=(P(), P("tp", None)), out_specs=P()))
+    r = fn(x, w)
+    r.block_until_ready()
+    return f"OK sum={float(r.sum()):.0f}"
+
+
+def leg_b():
+    """tp GSPMD-auto inside pp-manual region, sharded matmul in cond
+    (the round-4 configuration that deadlocked)."""
+    def body(x):
+        s = lax.axis_index("pp")
+
+        def tick(c, t):
+            def active():
+                w = jnp.ones((H, H), c.dtype)
+                y = c @ w
+                return jax.lax.with_sharding_constraint(
+                    y, NamedSharding(mesh, P(None, "tp")))
+
+            def idle():
+                return _v(("pp",), jnp.zeros((H, H), c.dtype))
+
+            y = _v(("pp",), lax.cond((t - s) >= 0, active, idle))
+            y = lax.ppermute(y, "pp",
+                             [(i, (i + 1) % 2) for i in range(2)])
+            return y, None
+
+        x = _v(("pp",), x)
+        out, _ = lax.scan(tick, x, jnp.arange(4))
+        return lax.psum(out, "pp") / 2
+
+    x = jnp.ones((H, H), jnp.float32)
+    fn = jax.jit(shard_map(
+        body, mesh=mesh, axis_names={"pp"},
+        in_specs=(P(),), out_specs=P()))
+    r = fn(x)
+    r.block_until_ready()
+    return f"OK sum={float(r.sum()):.0f}"
+
+
+def leg_c():
+    """Control: manual tp, psum hoisted OUT of the cond."""
+    def body(x, w):
+        s = lax.axis_index("pp")
+        tix = lax.axis_index("tp")
+
+        def tick(c, t):
+            def active():
+                xl = lax.dynamic_slice_in_dim(c, tix * (H // 2),
+                                              H // 2, 1)
+                return xl @ w
+
+            def idle():
+                return _v(("pp", "tp"), jnp.zeros((H, H), c.dtype))
+
+            part = lax.cond((t - s) >= 0, active, idle)
+            y = _v(("pp", "tp"), lax.psum(part, "tp"))  # unconditional
+            y = lax.ppermute(y, "pp",
+                             [(i, (i + 1) % 2) for i in range(2)])
+            return y, None
+
+        x = _v(("pp", "tp"), x)
+        out, _ = lax.scan(tick, x, jnp.arange(4))
+        return lax.psum(out, ("pp", "tp")) / 4
+
+    x = jnp.ones((H, H), jnp.float32)
+    w = jnp.ones((H, H), jnp.float32)
+    fn = jax.jit(shard_map(
+        body, mesh=mesh, axis_names={"pp", "tp"},
+        in_specs=(P(), P("tp", None)), out_specs=P()))
+    r = fn(x, w)
+    r.block_until_ready()
+    return f"OK sum={float(r.sum()):.0f}"
+
+
+def leg_d():
+    """sp-style collectives (all_gather fwd + psum_scatter) inside the
+    cond branch — the sequence-parallel stage-body case."""
+    def body(x, w):
+        s = lax.axis_index("pp")
+
+        tix = lax.axis_index("tp")
+
+        def tick(c, t):
+            def active():
+                # c is seq-sharded [H/2, H]; gather, row-parallel
+                # matmul on the local shard, reduce-scatter back
+                full = lax.all_gather(c, "tp", axis=0, tiled=True)
+                xl = lax.dynamic_slice_in_dim(jnp.tanh(full),
+                                              tix * (H // 2), H // 2, 1)
+                part = xl @ w                      # [H, H] partial
+                return _v(("pp", "tp"),
+                          lax.psum_scatter(part, "tp",
+                                           scatter_dimension=0,
+                                           tiled=True))  # [H/2, H]
+
+            def idle():
+                return _v(("pp", "tp"),
+                          jnp.zeros((H // 2, H), c.dtype))
+
+            y = lax.cond((t - s) >= 0, active, idle)
+            y = lax.ppermute(y, "pp",
+                             [(i, (i + 1) % 2) for i in range(2)])
+            return y, None
+
+        out, _ = lax.scan(tick, _v(("pp", "tp"), x), jnp.arange(4))
+        return lax.psum(out, "pp") / 2
+
+    x = jnp.ones((H, H), jnp.float32)
+    w = jnp.ones((H, H), jnp.float32)
+    fn = jax.jit(shard_map(
+        body, mesh=mesh, axis_names={"pp", "tp"},
+        in_specs=(P("tp", None), P("tp", None)),
+        out_specs=P("tp", None)))
+    r = fn(x, w)
+    r.block_until_ready()
+    return f"OK sum={float(r.sum()):.0f}"
+
+
+if __name__ == "__main__":
+    for name, leg in [("A manual-psum-in-cond", leg_a),
+                      ("B gspmd-auto-in-cond", leg_b),
+                      ("C psum-hoisted", leg_c),
+                      ("D sp-gather-scatter-in-cond", leg_d)]:
+        try:
+            r = _with_alarm(leg, 60)
+        except Exception as e:  # noqa: BLE001
+            r = f"ERROR {type(e).__name__}: {e}"
+        print(f"{name}: {r}", flush=True)

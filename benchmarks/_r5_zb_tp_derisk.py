@@ -1,0 +1,194 @@
+"""Round-5 de-risk: the full ZB-under-tp mechanics on a toy pipeline.
+
+Checks, on a pp2 x tp2 manual shard_map:
+  1. jax.vjp INSIDE a cond branch over a manual-tp stage body
+     (matmul with tp-sharded weight + explicit psum) — the AD-inserted
+     transpose psums land in-branch; does it trace/run/deadlock?
+  2. pcast varying->unvarying legality for emitting tp-identical
+     outputs through out_specs P().
+  3. Grad parity vs a single-device oracle.
+
+The toy: 2 pipeline stages, each stage y = psum(x @ W_local, tp)
+(row-parallel with x column-sliced locally), run as a cond-gated
+2-tick-per-phase mini schedule with ppermute hops; loss = sum(y_final).
+"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax._src.xla_bridge as xb
+xb._backend_factories.pop("axon", None)
+xb._backend_factories.pop("tpu", None)
+_f = xb._get_backend_uncached
+if getattr(_f, "__name__", "") == "_axon_get_backend_uncached" \
+        and _f.__closure__:
+    xb._get_backend_uncached = _f.__closure__[0].cell_contents
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+devs = np.array(jax.devices()[:4]).reshape(2, 2)
+mesh = Mesh(devs, ("pp", "tp"))
+H = 8
+M = 2   # microbatches
+
+
+def _v(x, axes=("pp",)):
+    """Cast varying over pp ONLY: stage-boundary values stay naturally
+    tp-invarying (the in-stage psum strips tp-variance), so epilogue
+    outputs can use P() out_specs without any demotion (jax has no
+    varying->invarying pcast).  Grad leaves match their param's vma."""
+    vma = getattr(jax.typeof(x), "vma", frozenset())
+    need = tuple(a for a in axes if a not in vma)
+    for a in need:
+        x = lax.pcast(x, a, to="varying")
+    return x
+
+
+def _zeros_like_vma(p):
+    """zeros with vma = {pp} + (tp iff the param leaf is tp-varying)."""
+    vma = getattr(jax.typeof(p), "vma", frozenset())
+    z = jnp.zeros(p.shape, p.dtype)
+    axes = ("pp",) + (("tp",) if "tp" in vma else ())
+    return lax.pcast(z, tuple(a for a in axes
+                              if a not in getattr(jax.typeof(z), "vma",
+                                                  frozenset())),
+                     to="varying")
+
+
+def stage(w_local, x):
+    """Row-parallel: slice x cols by tp rank, matmul local shard, psum."""
+    tix = lax.axis_index("tp")
+    xl = lax.dynamic_slice_in_dim(x, tix * (H // 2), H // 2, 1)
+    part = jnp.tanh(xl) @ w_local
+    return lax.psum(part, "tp")
+
+
+def pipe_body(ws, x0):
+    """Cond-gated 2-stage pipeline with in-branch vjp (B phase) and
+    in-branch param-vjp (W phase)."""
+    s = lax.axis_index("pp")
+    w = jax.tree_util.tree_map(lambda p: p[0], ws)   # my stage's W
+
+    T = M + 2 * (2 - 1)   # 1f1b grid
+    act0 = _v(jnp.zeros((H, H), jnp.float32))
+    cot0 = _v(jnp.zeros((H, H), jnp.float32))
+    stash0 = _v(jnp.zeros((3, H, H), jnp.float32))
+    grads0 = _zeros_like_vma(w)
+    dx0_buf0 = _v(jnp.zeros((M, H, H), jnp.float32))
+    loss0 = _v(jnp.zeros(()))
+
+    k = 3
+
+    def tick(carry, t):
+        act_in, cot_in, stash, grads, loss, dx0_buf = carry
+        mf = t - s
+        f_active = (mf >= 0) & (mf < M)
+        mf_c = jnp.clip(mf, 0, M - 1)
+        f_act = jnp.where(s == 0, x0[mf_c], act_in)
+
+        y = lax.cond(f_active,
+                     lambda: _v(stage(w, f_act)),
+                     lambda: _v(jnp.zeros((H, H), jnp.float32)))
+        stash = lax.dynamic_update_index_in_dim(
+            stash, f_act, jnp.mod(t, k), 0)
+
+        # last-stage loss seed
+        is_last = s == 1
+        loss = loss + jnp.where(is_last & f_active, jnp.sum(y), 0.0)
+        dy_seed = jnp.ones((H, H), jnp.float32)
+        cot = jnp.where(is_last, dy_seed, cot_in)
+
+        mb = t - 2 * (2 - 1) + s
+        b_active = (mb >= 0) & (mb < M)
+        x_b = stash[jnp.mod(t - 2 * (2 - 1 - s), k)]
+
+        def b_do():
+            # cot is tp-invarying by construction, matching the stage
+            # output's vma ({V:pp} — the in-stage psum strips tp)
+            _, vjpx = jax.vjp(lambda xx: stage(w, xx), x_b)
+            (dx,) = vjpx(cot)
+            return _v(dx)
+
+        dx = lax.cond(b_active, b_do,
+                      lambda: _v(jnp.zeros((H, H), jnp.float32)))
+
+        def w_do(g):
+            _, vjpp = jax.vjp(lambda pp: stage(pp, x_b), w)
+            (dw,) = vjpp(cot)
+            return jax.tree_util.tree_map(
+                lambda a, d: _zeros_like_vma(a) + a + d, g, dw)
+
+        grads = lax.cond(b_active, w_do, lambda g: _v(g), grads)
+
+        dx0_buf = lax.cond(
+            (s == 0) & b_active,
+            lambda buf: lax.dynamic_update_index_in_dim(
+                buf, dx, jnp.clip(mb, 0, M - 1), 0),
+            lambda buf: buf, dx0_buf)
+
+        act_out = lax.ppermute(y, "pp", [(0, 1), (1, 0)])
+        cot_out = lax.ppermute(dx, "pp", [(1, 0), (0, 1)])
+        return (act_out, cot_out, stash, grads, loss, dx0_buf), None
+
+    carry, _ = lax.scan(
+        tick, (act0, cot0, stash0, grads0, loss0, dx0_buf0),
+        jnp.arange(T))
+    _, _, _, grads, loss, dx0_buf = carry
+    # loss lives on the last pp stage only -> psum over pp; already
+    # tp-invarying (never cast over tp)
+    loss = lax.psum(loss, "pp")
+    # dx0_buf nonzero only on s==0, so the pp psum just collects it
+    dx0 = lax.psum(dx0_buf, "pp")
+    # re-add the leading stage dim so out_specs P('pp', 'tp', None)
+    # reassembles [pp, H, H]
+    return loss, grads[None], dx0
+
+
+def run():
+    key = jax.random.PRNGKey(0)
+    ws = jax.random.normal(key, (2, H // 2, H)) * 0.3  # [pp, H/tp(row), H]
+    # full weights for oracle: [stage, H, H] where rows split over tp
+    wfull = jax.random.normal(key, (2, H, H)) * 0.3
+    x0 = jax.random.normal(jax.random.PRNGKey(1), (M, H, H))
+
+    fn = jax.jit(shard_map(
+        pipe_body, mesh=mesh, axis_names={"pp", "tp"},
+        in_specs=(P("pp", "tp", None), P()),
+        out_specs=(P(), P("pp", "tp", None), P())))
+    loss, grads, dx0 = fn(wfull, x0)
+    loss.block_until_ready()
+
+    # oracle: sequential 2-stage forward on one device
+    def oracle(wfull, x0):
+        def stage_full(wf, x):
+            return jnp.tanh(x) @ wf
+        tot = 0.0
+        for mbi in range(M):
+            h = stage_full(wfull[0], x0[mbi])
+            y = stage_full(wfull[1], h)
+            tot = tot + jnp.sum(y)
+        return tot
+
+    oloss, (ogw, ogx) = jax.value_and_grad(oracle, argnums=(0, 1))(
+        wfull, x0)
+    print("loss", float(loss), "oracle", float(oloss))
+    np.testing.assert_allclose(float(loss), float(oloss), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads), np.asarray(ogw),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dx0), np.asarray(ogx),
+                               rtol=1e-4, atol=1e-5)
+    print("PARITY OK — in-branch vjp over manual-tp stage works")
+
+
+if __name__ == "__main__":
+    import signal
+
+    def bail(signum, frame):
+        raise SystemExit("DEADLOCK(alarm)")
+    signal.signal(signal.SIGALRM, bail)
+    signal.alarm(120)
+    run()

@@ -1,0 +1,65 @@
+"""Round-5 probe: wall-clock of the zero-bubble schedules UNDER tp=2
+vs the GSPMD 1F1B engine at matched config (dp1 x pp4 x tp2, hid 512,
+L8, M8, sp on) — the manual-tp analog of round 4's _r4_zb_probe.
+
+CPU-mesh numbers are directional only (no MXU, no ICI), but they show
+whether the cond-gating skip survives the manual-tp restructuring +
+serialize_phases barriers, or the barriers eat the win.
+"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    "--xla_force_host_platform_device_count=8 "
+    "--xla_cpu_enable_concurrency_optimized_scheduler=false")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax._src.xla_bridge as xb
+xb._backend_factories.pop("axon", None)
+xb._backend_factories.pop("tpu", None)
+_f = xb._get_backend_uncached
+if getattr(_f, "__name__", "") == "_axon_get_backend_uncached" \
+        and _f.__closure__:
+    xb._get_backend_uncached = _f.__closure__[0].cell_contents
+
+import time
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.models.gpt import GPTConfig
+from paddle_tpu.models import gpt_hybrid as GH
+
+cfg = GPTConfig(vocab_size=512, hidden_size=512, num_layers=8,
+                num_heads=8, max_seq_len=128)
+
+results = {}
+for sched in ["1f1b", "zbh1", "zbvpp"]:
+    pcfg = GH.ParallelConfig(
+        dp=1, tp=2, pp=4, sp=True, microbatches=8,
+        pp_schedule=sched, remat=True,
+        param_dtype=jnp.float32, compute_dtype=jnp.float32,
+        fused_ce=False)
+    mesh = GH.build_mesh(pcfg)
+    params = GH.init_params(cfg, pcfg, jax.random.PRNGKey(0))
+    params, _ = GH.shard_params(params, mesh, cfg, pcfg)
+    ids = jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (8, 128)))
+    fn = jax.jit(lambda p, b: GH._train_grads_1f1b(p, b, cfg, pcfg,
+                                                   mesh))
+    with mesh:
+        loss, grads = fn(params, (ids, ids))
+        loss.block_until_ready()
+        times = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            loss, grads = fn(params, (ids, ids))
+            loss.block_until_ready()
+            times.append((time.perf_counter() - t0) * 1e3)
+    results[sched] = (min(times), float(loss))
+    print(f"{sched:6s}: best {min(times):8.1f} ms/step  "
+          f"(all {['%.0f' % t for t in times]})  loss {float(loss):.4f}",
+          flush=True)
+
+r = results
+print(f"\nzbh1/1f1b: {r['zbh1'][0] / r['1f1b'][0]:.3f}  "
+      f"zbvpp/1f1b: {r['zbvpp'][0] / r['1f1b'][0]:.3f}")

@@ -111,10 +111,15 @@ class Engine:
                 zero_bubble=False):
         """zero_bubble compiles pp>1 plans onto a zero-bubble
         dx/dW-split ring instead of 1F1B when the plan's stage bodies
-        are collective-free (tp==1); ignored otherwise — mirrors
-        planner.PlanCandidate.to_parallel_config(zero_bubble=...).
-        True selects ZBH1; the string "zbvpp" selects the two-chunk
-        V-placement schedule (needs blocks % 2*pp == 0)."""
+        are collective-free (tp==1); ignored otherwise. True selects
+        ZBH1; the string "zbvpp" selects the two-chunk V-placement
+        schedule (needs blocks % 2*pp == 0). Note: the generic
+        partitioner keeps the tp==1 gate because arbitrary user models
+        get GSPMD-auto tp (annotate_tp), whose collectives deadlock
+        inside the cond-gated phases; the HYBRID engine
+        (models/gpt_hybrid.py + planner.to_parallel_config) composes
+        zero-bubble with tp>1 via its manual-tp stage body
+        (models/gpt_manual_tp.py)."""
         self._zero_bubble = zero_bubble
         import paddle_tpu as paddle
 

@@ -101,11 +101,16 @@ class PlanCandidate:
         zero_bubble=True upgrades the pipeline schedule to the compiled
         zero-bubble ZBH1; zero_bubble="zbvpp" selects the ZB-V schedule
         (matching Engine.prepare's contract); other strings raise.
-        The upgrade applies when the plan's stage bodies are
-        collective-free (tp==1 — the cond-gating constraint,
-        gpt_hybrid._validate_pp_schedule); with tp>1 the knob is
-        ignored (1F1B) rather than refused, so planner-driven configs
-        stay runnable."""
+        Since round 5 the upgrade applies under tp>1 too (the hybrid
+        engine switches to the manual-tp stage body with explicit
+        in-branch collectives, models/gpt_manual_tp.py). Preconditions
+        the manual-tp body adds beyond 1F1B, checked with clear errors
+        at build/trace time: num_heads % tp == 0 (the candidate
+        enumerator already guarantees this for planner-built plans) and
+        — under sp — seq_len % tp == 0 (the planner cannot know the
+        batch shape; pick 1f1b or pad the sequence if your seq length
+        does not divide tp). The collective-matmul ring is incompatible
+        but never coincides (a pp==1 construct)."""
         from paddle_tpu.models.gpt_hybrid import ParallelConfig
         if isinstance(zero_bubble, str) and \
                 zero_bubble not in ("zbh1", "zbvpp"):
@@ -114,7 +119,7 @@ class PlanCandidate:
                 "expected True, 'zbh1' or 'zbvpp'")
         zb_sched = zero_bubble if isinstance(zero_bubble, str) else "zbh1"
         sched = "gpipe" if self.pp <= 1 else (
-            zb_sched if zero_bubble and self.tp == 1 else "1f1b")
+            zb_sched if zero_bubble else "1f1b")
         kw = dict(dp=self.dp, tp=self.tp, pp=self.pp, sp=self.sp,
                   microbatches=self.microbatches,
                   pp_schedule=sched,

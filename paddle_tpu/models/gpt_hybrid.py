@@ -51,8 +51,10 @@ class ParallelConfig:
     # with O(pp) liveness (parallel/pipeline_1f1b.py — the compiled
     # analog of the reference 1F1B, pipeline_parallel.py:547);
     # "zbh1"/"zbvpp": zero-bubble schedules with cond-gated phases and
-    # dx/dW-split backward (reference pipeline_zero_bubble.py:62/:151)
-    # — require a collective-free stage body (tp=1, no EP-MoE).
+    # dx/dW-split backward (reference pipeline_zero_bubble.py:62/:151).
+    # tp>1 composes via the manual-tp stage body with explicit
+    # in-branch collectives (models/gpt_manual_tp.py, round 5);
+    # EP-MoE does not (no manual form for the all-to-all).
     # "zbvpp" runs TWO model chunks per device in the V placement
     # (layers split 2*pp ways; num_layers % (2*pp) == 0)
     pp_schedule: str = "gpipe"
@@ -177,7 +179,14 @@ def param_specs(cfg: GPTConfig, pcfg: ParallelConfig) -> Dict:
             "fc2_w": P(pp, "tp", None), "fc2_b": P(pp, None),
         })
     return {
-        "wte": P("tp", None), "wpe": P(None, None),
+        # vocab-sharded embedding (Megatron VocabParallelEmbedding)
+        # when the vocab divides tp; replicated storage otherwise so
+        # odd vocabs (e.g. GPT-2's 50257) stay runnable at any tp —
+        # the manual-tp zero-bubble head re-pads to a tp multiple
+        # internally (gpt_manual_tp.train_grads_zb_manual_tp)
+        "wte": P("tp", None) if cfg.vocab_size % max(pcfg.tp, 1) == 0
+        else P(None, None),
+        "wpe": P(None, None),
         "blocks": blocks,
         "lnf_g": P(None), "lnf_b": P(None),
     }
@@ -630,6 +639,14 @@ def _train_grads_1f1b(params, batch, cfg, pcfg, mesh):
     from paddle_tpu.parallel.pipeline import pipeline_microbatch
     from paddle_tpu.parallel.pipeline_1f1b import pipeline_train_1f1b
 
+    if pcfg.pp_schedule in ("zbh1", "zbvpp") and pcfg.tp > 1:
+        # zero-bubble under tp>1: the cond-gated phases need EXPLICIT
+        # tp collectives (manual axis) — GSPMD-auto ones deadlock
+        # in-branch (round-4 wall; round-5 manual-tp formulation)
+        from paddle_tpu.models.gpt_manual_tp import \
+            train_grads_zb_manual_tp
+        return train_grads_zb_manual_tp(params, batch, cfg, pcfg, mesh)
+
     input_ids, labels = batch
     cdt = pcfg.compute_dtype
     b, s = input_ids.shape
@@ -717,18 +734,23 @@ def _validate_pp_schedule(pcfg):
             "vpp_chunks > 1 requires pp > 1 with pp_schedule='1f1b' "
             "(the interleaved schedule generalizes the compiled 1F1B; "
             "'zbvpp' brings its own two V-placed chunks)")
-    if pcfg.pp_schedule in ("zbh1", "zbvpp") and (
-            pcfg.tp > 1 or (pcfg.num_experts > 0 and pcfg.dp > 1)):
+    if pcfg.pp_schedule in ("zbh1", "zbvpp") and pcfg.num_experts > 0 \
+            and (pcfg.dp > 1 or pcfg.tp > 1):
         raise ValueError(
-            f"pp_schedule={pcfg.pp_schedule!r} requires a "
-            "collective-free stage body "
-            "(tp=1, no expert-parallel MoE): the zero-bubble phases are "
-            "cond-gated per pipeline stage, and GSPMD-inserted tp/ep "
-            "collectives inside a cond branch deadlock the mesh (half "
-            "the devices wait inside the branch's collective, half at "
-            "the next ring permute). dp composes fine — its gradient "
-            "psum sits outside the gated region. Use '1f1b' for "
-            "tp/ep hybrids.")
+            f"pp_schedule={pcfg.pp_schedule!r} does not compose with "
+            "expert-parallel MoE: the zero-bubble phases are cond-gated "
+            "per pipeline stage and the GSPMD-inserted EP all-to-all "
+            "inside a cond branch deadlocks the mesh (and the manual-tp "
+            "stage body has no MoE form). tp>1 DOES compose since round "
+            "5 — the stage body switches to the manual-tp formulation "
+            "with explicit in-branch collectives "
+            "(models/gpt_manual_tp.py). Use '1f1b' for EP hybrids.")
+    if pcfg.pp_schedule in ("zbh1", "zbvpp") and pcfg.tp > 1 \
+            and pcfg.collective_matmul:
+        raise ValueError(
+            "zero-bubble schedules use the manual-tp stage body, which "
+            "does not take the collective-matmul ring path (that ring "
+            "is a pp==1 construct anyway — _use_cm)")
     if pcfg.pp_schedule == "zbvpp" and pcfg.pp <= 1:
         raise ValueError("pp_schedule='zbvpp' requires pp > 1 (the "
                          "V placement spans a pipeline ring)")

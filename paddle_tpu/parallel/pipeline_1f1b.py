@@ -51,6 +51,24 @@ def _varying_cast(axis_name: str, x):
     return jax.tree_util.tree_map(one, x)
 
 
+def _vma_of(x) -> frozenset:
+    return getattr(jax.typeof(x), "vma", frozenset())
+
+
+def _zeros_matching_vma(ref, shape=None, dtype=None, extra=()):
+    """Fresh zeros whose varying-manual-axes type matches `ref`'s vma
+    (plus `extra` axes). Zero literals start unvarying on every manual
+    axis; scan carries, cond branches and vjp cotangents must agree on
+    vma, and under manual-tp stage bodies (round 5) different leaves
+    legitimately carry different vma — tp-sharded weight grads are
+    tp-varying while ln/bias grads are tp-invarying — so a blanket cast
+    over the pipeline axis is not enough."""
+    z = jnp.zeros(ref.shape if shape is None else shape,
+                  ref.dtype if dtype is None else dtype)
+    need = tuple((set(_vma_of(ref)) | set(extra)) - _vma_of(z))
+    return lax.pcast(z, need, to="varying") if need else z
+
+
 def _pipeline_epilogue(axis_name, s, n, loss, head, dx0_buf, grads,
                        grad_dtype, dtype, head_stage=None):
     """Shared final psums of every compiled pipeline variant: loss and
@@ -469,12 +487,51 @@ def compiled_zbh1_schedule(n_stages: int, n_microbatches: int) -> Schedule:
                     durations={"F": 1.0, "B": 2.0, "W": 2.0})
 
 
+def _phase_after(x, *deps):
+    """Order phase `x`'s computation after EVERY leaf of `deps` via an
+    optimization_barrier data dependency. Needed when the stage body
+    carries manual collectives: XLA's concurrent thunk executor may
+    issue data-independent in-branch collectives in DIFFERENT orders on
+    different devices, and two devices of the same subgroup blocked on
+    each other's pending collective deadlock the rendezvous (observed
+    on XLA:CPU for zbvpp+sp, round 5). All leaves matter — a
+    single-leaf dep leaves the other leaves' producing collectives
+    off-chain and the race stands. A plain `+ 0*dep` would be
+    algebraically simplified away; the barrier survives.
+
+    vma hygiene: optimization_barrier UNIFIES the varying-manual-axes
+    type across its operands, so a dep leaf varying over axes `x` does
+    not vary over (e.g. a tp-sharded weight grad vs a tp-invarying
+    cotangent) would widen x's type and break downstream vjp typing.
+    Deps are reduced to per-leaf scalars (an op-level dependency — XLA
+    cannot partially execute the producing op), and scalars with
+    excess axes are psum'd over exactly those axes (the psum is itself
+    a uniform unconditional collective, correctly ordered after the
+    dep's producers)."""
+    xv = _vma_of(x)
+    toks, excess = [], {}
+    for d in deps:
+        for leaf in jax.tree_util.tree_leaves(d):
+            t = jnp.ravel(leaf)[0]
+            lv = _vma_of(leaf)
+            if lv <= xv:
+                toks.append(t)
+            else:
+                ax = tuple(sorted(lv - xv))
+                excess.setdefault(ax, []).append(t.astype(jnp.float32))
+    for ax, ts in excess.items():
+        toks.append(lax.psum(sum(ts), ax))
+    out = lax.optimization_barrier((x, *toks))
+    return out[0]
+
+
 def pipeline_train_zbh1(stage_fn: Callable, stage_params, x_microbatches,
                         last_stage_grad: Callable,
                         head_params=None,
                         axis_name: str = "pp",
                         grad_dtype=jnp.float32,
-                        side_inputs=None):
+                        side_inputs=None,
+                        serialize_phases: bool = False):
     """Zero-bubble ZBH1 on the compiled 1F1B ring.
 
     Two departures from `pipeline_train_1f1b`:
@@ -500,6 +557,13 @@ def pipeline_train_zbh1(stage_fn: Callable, stage_params, x_microbatches,
     the forward leg indexes them at its microbatch, the B recompute at
     its, and the deferred W recompute at the microbatch it retires —
     W's fire in microbatch order, so nW IS that index).
+
+    `serialize_phases=True` (the manual-tp caller) additionally orders
+    the ring permutes after the W phase via `_phase_after`: with
+    collectives inside the cond-gated phases, a permute racing a
+    pending subgroup collective on another device deadlocks the
+    rendezvous. F->head->B->W are already serialized by true data deps
+    (dy_seed, the W stash).
     """
     n = lax.axis_size(axis_name)
     s = lax.axis_index(axis_name)
@@ -527,19 +591,32 @@ def pipeline_train_zbh1(stage_fn: Callable, stage_params, x_microbatches,
 
     x_shape = x_microbatches.shape[1:]
     dtype = x_microbatches.dtype
-    act0 = _v(jnp.zeros(x_shape, dtype))
-    cot0 = _v(jnp.zeros(x_shape, dtype))
-    stash0 = _v(jnp.zeros((k,) + x_shape, dtype))
-    wstash_x0 = _v(jnp.zeros((wk,) + x_shape, dtype))
-    wstash_gy0 = _v(jnp.zeros((wk,) + x_shape, dtype))
+
+    def _za(shape=None, dt=None):
+        """Activation-typed zeros: vma = x_microbatches' vma + the
+        pipeline axis. Under a manual-tp caller with sp, activations
+        are tp-varying (sequence-sharded); without, tp-invarying — the
+        idle cond branches and carries must match either way."""
+        return _zeros_matching_vma(
+            x_microbatches, shape=x_shape if shape is None else shape,
+            dtype=dtype if dt is None else dt, extra=(axis_name,))
+
+    act0 = _za()
+    cot0 = _za()
+    stash0 = _za((k,) + x_shape)
+    wstash_x0 = _za((wk,) + x_shape)
+    wstash_gy0 = _za((wk,) + x_shape)
+    # grad accumulators match each PARAM leaf's vma (tp-sharded leaves
+    # are tp-varying under a manual-tp stage body, ln/bias leaves not)
     grads0 = jax.tree_util.tree_map(
-        lambda p: _v(jnp.zeros(p.shape, grad_dtype)), my_params)
-    _, _, probe_hg = last_stage_grad(jnp.zeros(x_shape, dtype),
-                                     head_params_v,
+        lambda p: _zeros_matching_vma(p, dtype=grad_dtype,
+                                      extra=(axis_name,)), my_params)
+    _, _, probe_hg = last_stage_grad(_za(), head_params_v,
                                      jnp.zeros((), jnp.int32))
     head0 = None if probe_hg is None else jax.tree_util.tree_map(
-        lambda g: _v(jnp.zeros(g.shape, grad_dtype)), probe_hg)
-    dx0_buf0 = _v(jnp.zeros((m,) + x_shape, dtype))
+        lambda g: _zeros_matching_vma(g, dtype=grad_dtype,
+                                      extra=(axis_name,)), probe_hg)
+    dx0_buf0 = _za((m,) + x_shape)
 
     def w_phase(nW, grads, wstash_x, wstash_gy, fire):
         """Retire ONE deferred weight-grad when `fire`: recompute the
@@ -568,7 +645,7 @@ def pipeline_train_zbh1(stage_fn: Callable, stage_params, x_microbatches,
         f_act = jnp.where(s == 0, x_microbatches[mf_c], act_in)
         y = lax.cond(f_active,
                      lambda: _v(_stage(my_params, f_act, mf_c)),
-                     lambda: _v(jnp.zeros(x_shape, dtype)))
+                     lambda: _za())
         stash = lax.dynamic_update_index_in_dim(
             stash, f_act, jnp.mod(t, k), 0)
         # ---------------- last-stage loss seed (masked adds, as 1F1B)
@@ -585,6 +662,11 @@ def pipeline_train_zbh1(stage_fn: Callable, stage_params, x_microbatches,
         mb = t - 2 * (n - 1) + s
         b_active = (mb >= 0) & (mb < m)
         cot = jnp.where(is_last, dy_seed, cot_in)
+        if serialize_phases:
+            # B strictly after the WHOLE head vjp (its param-grad
+            # collectives are off the dy_seed dataflow path)
+            cot = _phase_after(cot, loss_mb,
+                               hgrads if hgrads is not None else ())
         x_b = stash[jnp.mod(t - 2 * (n - 1 - s), k)]
         mb_c = jnp.clip(mb, 0, m - 1)
 
@@ -594,8 +676,7 @@ def pipeline_train_zbh1(stage_fn: Callable, stage_params, x_microbatches,
             (dx,) = vjpx(cot.astype(y.dtype))
             return _v(dx)
 
-        dx = lax.cond(b_active, b_do,
-                      lambda: _v(jnp.zeros(x_shape, y.dtype)))
+        dx = lax.cond(b_active, b_do, lambda: _za(dt=y.dtype))
         # stash (x, gy) for the deferred weight-grad; slot nB mod wk
         nB_prev = jnp.clip(t - 2 * (n - 1) + s, 0, m)  # B's before t
         wslot = jnp.mod(nB_prev, wk)
@@ -618,8 +699,14 @@ def pipeline_train_zbh1(stage_fn: Callable, stage_params, x_microbatches,
                 buf, dx.astype(dtype), jnp.clip(mb, 0, m - 1), 0),
             lambda buf: buf, dx0_buf)
         # ---------------- hops
-        act_out = lax.ppermute(y, axis_name, fwd_perm)
-        cot_out = lax.ppermute(dx, axis_name, bwd_perm)
+        y_h, dx_h = y, dx
+        if serialize_phases:
+            y_h = _phase_after(y, grads)
+            dx_h = _phase_after(dx, y_h)
+        act_out = lax.ppermute(y_h, axis_name, fwd_perm)
+        if serialize_phases:
+            dx_h = _phase_after(dx_h, act_out)
+        cot_out = lax.ppermute(dx_h, axis_name, bwd_perm)
         return (act_out, cot_out, stash, wstash_x, wstash_gy, nW, grads,
                 head, loss, dx0_buf), None
 
@@ -630,7 +717,10 @@ def pipeline_train_zbh1(stage_fn: Callable, stage_params, x_microbatches,
     (_, _, _, wstash_x, wstash_gy, nW, grads, head, loss,
      dx0_buf) = carry
 
-    # drain: retire the remaining W backlog, no collectives involved
+    # drain: retire the remaining W backlog. Under a manual-tp stage
+    # body the W vjp recompute DOES replay tp collectives in its
+    # fire-gated cond — safe because the fire predicate is uniform
+    # across each tp subgroup (it depends only on the pp stage index)
     n_extra = zbh1_extra_ticks(
         int(n) if isinstance(n, int) else n, m)
 
@@ -714,7 +804,8 @@ def pipeline_train_zbvpp(stage_fn: Callable, stage_params,
                          head_params=None,
                          axis_name: str = "pp",
                          grad_dtype=jnp.float32,
-                         side_inputs=None):
+                         side_inputs=None,
+                         serialize_phases: bool = False):
     """Zero-bubble ZB-V on the compiled ring: interleaved VPP with TWO
     chunks in V placement + the ZBH1 dx/dW split, in ONE XLA program.
 
@@ -782,14 +873,23 @@ def pipeline_train_zbvpp(stage_fn: Callable, stage_params,
 
     x_shape = x_microbatches.shape[1:]
     dtype = x_microbatches.dtype
-    zact = lambda: _v(jnp.zeros(x_shape, dtype))  # noqa: E731
+
+    def _za(shape=None, dt=None):
+        """Activation-typed zeros matching x_microbatches' vma (+ the
+        pipeline axis) — see pipeline_train_zbh1."""
+        return _zeros_matching_vma(
+            x_microbatches, shape=x_shape if shape is None else shape,
+            dtype=dtype if dt is None else dt, extra=(axis_name,))
+
+    zact = _za
     grads0 = jax.tree_util.tree_map(
-        lambda p: _v(jnp.zeros(p.shape, grad_dtype)), lane_params)
-    _, _, probe_hg = last_stage_grad(jnp.zeros(x_shape, dtype),
-                                     head_params_v,
+        lambda p: _zeros_matching_vma(p, dtype=grad_dtype,
+                                      extra=(axis_name,)), lane_params)
+    _, _, probe_hg = last_stage_grad(_za(), head_params_v,
                                      jnp.zeros((), jnp.int32))
     head0 = None if probe_hg is None else jax.tree_util.tree_map(
-        lambda g: _v(jnp.zeros(g.shape, grad_dtype)), probe_hg)
+        lambda g: _zeros_matching_vma(g, dtype=grad_dtype,
+                                      extra=(axis_name,)), probe_hg)
 
     def w_phase(lane_p, wk, nW, lane_grads, wx, wgy, fire):
         """Retire ONE deferred weight-grad of one lane when `fire`.
@@ -825,6 +925,12 @@ def pipeline_train_zbvpp(stage_fn: Callable, stage_params,
         f1_active = (mf1 >= 0) & (mf1 < m)
         mf1_c = jnp.clip(mf1, 0, m - 1)
         x1 = jnp.where(s == n - 1, y0_prev, a1_in)
+        if serialize_phases:
+            # the two lanes have no natural data dep within a tick
+            # (x1 comes from LAST tick's y0) — with collectives in the
+            # stage body they must issue in one canonical order:
+            # F0 -> F1 -> head -> B1 -> B0 -> W0 -> W1 -> hops
+            x1 = _phase_after(x1, y0)
         y1 = lax.cond(f1_active,
                       lambda: _v(_stage(params1, x1, mf1_c)), zact)
         stash1 = lax.dynamic_update_index_in_dim(
@@ -844,6 +950,10 @@ def pipeline_train_zbvpp(stage_fn: Callable, stage_params,
         b1_active = (mb1 >= 0) & (mb1 < m)
         mb1_c = jnp.clip(mb1, 0, m - 1)
         cot1 = jnp.where(is_head, dy_seed, c1_in)
+        if serialize_phases:
+            # B1 strictly after the WHOLE head vjp — see zbh1
+            cot1 = _phase_after(cot1, loss_mb,
+                                hgrads if hgrads is not None else ())
         x_b1 = stash1[jnp.mod(t - 2 * s, k1)]
 
         def b1_do():
@@ -852,8 +962,7 @@ def pipeline_train_zbvpp(stage_fn: Callable, stage_params,
             (dx,) = vjpx(cot1.astype(y1.dtype))
             return _v(dx)
 
-        dx1 = lax.cond(b1_active, b1_do,
-                       lambda: _v(jnp.zeros(x_shape, y1.dtype)))
+        dx1 = lax.cond(b1_active, b1_do, lambda: _za(dt=y1.dtype))
         wslot1 = jnp.mod(jnp.clip(mb1, 0, m), wk1)
         wx1, wgy1 = lax.cond(
             b1_active,
@@ -867,6 +976,8 @@ def pipeline_train_zbvpp(stage_fn: Callable, stage_params,
         b0_active = (mb0 >= 0) & (mb0 < m)
         mb0_c = jnp.clip(mb0, 0, m - 1)
         cot0 = jnp.where(s == n - 1, dx1_prev, c0_in)
+        if serialize_phases:
+            cot0 = _phase_after(cot0, dx1)   # B0 after B1
         x_b0 = stash0[jnp.mod(t - 2 * (ng - 1 - s), k0)]
 
         def b0_do():
@@ -875,8 +986,7 @@ def pipeline_train_zbvpp(stage_fn: Callable, stage_params,
             (dx,) = vjpx(cot0.astype(y0.dtype))
             return _v(dx)
 
-        dx0 = lax.cond(b0_active, b0_do,
-                       lambda: _v(jnp.zeros(x_shape, y0.dtype)))
+        dx0 = lax.cond(b0_active, b0_do, lambda: _za(dt=y0.dtype))
         wslot0 = jnp.mod(jnp.clip(mb0, 0, m), wk0)
         wx0, wgy0 = lax.cond(
             b0_active,
@@ -893,7 +1003,8 @@ def pipeline_train_zbvpp(stage_fn: Callable, stage_params,
         nB1 = jnp.clip(t - 2 * (ng - 1) + sigma1 + 1, 0, m)
         pend1 = nB1 - nW1
         fire1 = (pend1 > 0) & (~f1_active | (pend1 > sigma1))
-        nW1, g1 = w_phase(params1, wk1, nW1, g1, wx1, wgy1, fire1)
+        wgy1_w = _phase_after(wgy1, g0) if serialize_phases else wgy1
+        nW1, g1 = w_phase(params1, wk1, nW1, g1, wx1, wgy1_w, fire1)
         grads = jax.tree_util.tree_map(
             lambda a, b_: jnp.stack([a, b_]), g0, g1)
         # ---------------- input cotangents: vstage 0 is on device 0
@@ -903,31 +1014,44 @@ def pipeline_train_zbvpp(stage_fn: Callable, stage_params,
                 buf, dx0.astype(dtype), jnp.clip(mb0, 0, m - 1), 0),
             lambda buf: buf, dx0_buf)
         # ---------------- hops: fwd ring (y0, dx1), bwd ring (y1, dx0)
-        a0_out = lax.ppermute(y0, axis_name, fwd_perm)
-        c1_out = lax.ppermute(dx1, axis_name, fwd_perm)
-        a1_out = lax.ppermute(y1, axis_name, bwd_perm)
-        c0_out = lax.ppermute(dx0, axis_name, bwd_perm)
+        y0_h, dx1_h, y1_h, dx0_h = y0, dx1, y1, dx0
+        if serialize_phases:
+            y0_h = _phase_after(y0, g1)
+            a0_out = lax.ppermute(y0_h, axis_name, fwd_perm)
+            dx1_h = _phase_after(dx1, a0_out)
+            c1_out = lax.ppermute(dx1_h, axis_name, fwd_perm)
+            y1_h = _phase_after(y1, c1_out)
+            a1_out = lax.ppermute(y1_h, axis_name, bwd_perm)
+            dx0_h = _phase_after(dx0, a1_out)
+            c0_out = lax.ppermute(dx0_h, axis_name, bwd_perm)
+        else:
+            a0_out = lax.ppermute(y0_h, axis_name, fwd_perm)
+            c1_out = lax.ppermute(dx1_h, axis_name, fwd_perm)
+            a1_out = lax.ppermute(y1_h, axis_name, bwd_perm)
+            c0_out = lax.ppermute(dx0_h, axis_name, bwd_perm)
         return (a0_out, a1_out, c0_out, c1_out, y0, dx1,
                 stash0, stash1, wx0, wgy0, wx1, wgy1, nW0, nW1,
                 grads, head, loss, dx0_buf), None
 
     carry0 = (zact(), zact(), zact(), zact(), zact(), zact(),
-              _v(jnp.zeros((k0,) + x_shape, dtype)),
-              _v(jnp.zeros((k1,) + x_shape, dtype)),
-              _v(jnp.zeros((wk0,) + x_shape, dtype)),
-              _v(jnp.zeros((wk0,) + x_shape, dtype)),
-              _v(jnp.zeros((wk1,) + x_shape, dtype)),
-              _v(jnp.zeros((wk1,) + x_shape, dtype)),
+              _za((k0,) + x_shape),
+              _za((k1,) + x_shape),
+              _za((wk0,) + x_shape),
+              _za((wk0,) + x_shape),
+              _za((wk1,) + x_shape),
+              _za((wk1,) + x_shape),
               _v(jnp.zeros((), jnp.int32)),
               _v(jnp.zeros((), jnp.int32)),
               grads0,
               head0, _v(jnp.zeros((), grad_dtype)),
-              _v(jnp.zeros((m,) + x_shape, dtype)))
+              _za((m,) + x_shape))
     carry, _ = lax.scan(tick, carry0, jnp.arange(t_total))
     (_, _, _, _, _, _, _, _, wx0, wgy0, wx1, wgy1, nW0, nW1,
      grads, head, loss, dx0_buf) = carry
 
-    # drain: retire remaining W backlogs, no collectives involved
+    # drain: retire remaining W backlogs (manual-tp: the recompute
+    # replays tp collectives — tp-subgroup-uniform fire predicates,
+    # and serialize_phases orders W0 before W1, as in the main grid)
     n_extra = zbvpp_extra_ticks(int(n) if isinstance(n, int) else n, m)
 
     def drain(carry, _t):
@@ -935,7 +1059,8 @@ def pipeline_train_zbvpp(stage_fn: Callable, stage_params,
         g0 = jax.tree_util.tree_map(lambda g: g[0], grads)
         g1 = jax.tree_util.tree_map(lambda g: g[1], grads)
         nW0, g0 = w_phase(params0, wk0, nW0, g0, wx0, wgy0, nW0 < m)
-        nW1, g1 = w_phase(params1, wk1, nW1, g1, wx1, wgy1, nW1 < m)
+        wgy1_d = _phase_after(wgy1, g0) if serialize_phases else wgy1
+        nW1, g1 = w_phase(params1, wk1, nW1, g1, wx1, wgy1_d, nW1 < m)
         grads = jax.tree_util.tree_map(
             lambda a, b_: jnp.stack([a, b_]), g0, g1)
         return (nW0, nW1, grads), None

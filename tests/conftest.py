@@ -12,8 +12,18 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+    _flags = (_flags + " --xla_force_host_platform_device_count=8")
+if "xla_cpu_enable_concurrency_optimized_scheduler" not in _flags:
+    # the concurrency-optimized CPU thunk scheduler issues data-
+    # independent collectives in divergent per-device orders; with the
+    # manual-tp zero-bubble pipelines (explicit collectives inside
+    # cond-gated phases) that deadlocks the rendezvous (round 5 —
+    # models/gpt_manual_tp.py). Sequential thunk scheduling restores
+    # the uniform issue order. TPU is unaffected (per-core program
+    # order is always uniform).
+    _flags = (_flags
+              + " --xla_cpu_enable_concurrency_optimized_scheduler=false")
+os.environ["XLA_FLAGS"] = _flags.strip()
 
 import jax  # noqa: E402
 

@@ -192,10 +192,14 @@ def test_zbvpp_rejects_collective_stage_bodies_and_bad_layers():
     from paddle_tpu.models import gpt_hybrid as GH
     cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=4,
                     num_heads=2, max_seq_len=16)
-    pcfg = GH.ParallelConfig(dp=1, pp=2, tp=2, microbatches=2,
-                             pp_schedule="zbvpp")
-    with pytest.raises(ValueError, match="collective-free"):
+    # tp>1 composes since round 5 (manual-tp stage body); EP-MoE
+    # remains refused — no manual in-branch form for the all-to-all
+    pcfg = GH.ParallelConfig(dp=2, pp=2, tp=1, microbatches=2,
+                             num_experts=2, pp_schedule="zbvpp")
+    with pytest.raises(ValueError, match="MoE"):
         GH.build_train_step(cfg, pcfg, None)
+    GH._validate_pp_schedule(GH.ParallelConfig(
+        dp=1, pp=2, tp=2, microbatches=2, pp_schedule="zbvpp"))
     # pp=1 has no ring for the V placement
     with pytest.raises(ValueError, match="pp > 1"):
         GH.build_train_step(

@@ -155,16 +155,15 @@ def test_plan_to_parallel_config_carries_collective_matmul():
 
 
 def test_plan_to_parallel_config_zero_bubble_knob():
-    """zero_bubble=True upgrades pp>1 plans to the compiled ZBH1 only
-    when the stage bodies are collective-free (tp==1); with tp>1 the
-    knob is ignored (1f1b) so planner configs stay runnable."""
+    """zero_bubble=True upgrades pp>1 plans to the compiled ZBH1 —
+    since round 5 under tp>1 too (manual-tp stage body)."""
     from paddle_tpu.distributed.planner import PlanCandidate
     p = PlanCandidate(dp=2, tp=1, pp=4, microbatches=8)
     assert p.to_parallel_config(zero_bubble=True).pp_schedule == "zbh1"
     assert p.to_parallel_config().pp_schedule == "1f1b"
     p_tp = PlanCandidate(dp=1, tp=2, pp=4, microbatches=8)
     assert p_tp.to_parallel_config(
-        zero_bubble=True).pp_schedule == "1f1b"
+        zero_bubble=True).pp_schedule == "zbh1"
     p1 = PlanCandidate(dp=8, tp=1, pp=1)
     assert p1.to_parallel_config(
         zero_bubble=True).pp_schedule == "gpipe"
