@@ -1,0 +1,154 @@
+"""Minimal standalone reproducer of the collective-matmul-under-pp
+Shardy wall (upstreamable verbatim).
+
+The construct: a remat'd stage whose body opens an INNER tp-manual
+shard_map (the ring collective matmul), differentiated inside an OUTER
+pp-manual region's scan — the compiled-1F1B pattern of
+paddle_tpu/parallel/pipeline_1f1b.py with
+paddle_tpu/parallel/collective_matmul.py rings in the stage body.
+
+Observed failure modes on jax 0.9.0 (which one fires depends on the
+exact structure; the canary test
+tests/test_collective_matmul.py::test_cm_under_pp_upstream_wall asserts
+that at least one still does):
+  (a) 'manual axes must come before free axes' — a rank-1 operand's
+      vma {pp, tp} squashes both manual axes onto dim 0 of the inner
+      region's operand;
+  (b) 'operates on axis already bound by parent' — when the
+      vma-widening pcast sits inside the inner region;
+  (c) scan-carry vma mismatches between the pp-varying carry and the
+      inner region's output.
+
+Round-5 note: the CAPABILITY (ring collective matmul overlapping the
+sp linears under pp>1) is delivered anyway via the manual-tp stage
+body — tp manual at the SAME level as pp, no nested region, see
+models/gpt_manual_tp.py — so this file tracks only the upstream
+expressibility limit of the nested-region formulation used by the
+GSPMD-auto-tp engines.
+
+Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+     python benchmarks/_cm_repro.py
+Expected: a Shardy/vma error at trace/compile time (NOT a crash and
+NOT success). Success means the upstream wall has cleared — then flip
+gpt_hybrid._use_cm's pp==1 gate and planner.collective_matmul.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4").strip()
+
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+try:  # neutralize this box's axon sitecustomize shim, if present
+    import jax._src.xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+    _f = _xb._get_backend_uncached
+    if getattr(_f, "__name__", "") == "_axon_get_backend_uncached" \
+            and _f.__closure__:
+        _xb._get_backend_uncached = _f.__closure__[0].cell_contents
+except Exception:  # noqa: BLE001
+    pass
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def main():
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                ("pp", "tp"))
+    B, S, H = 2, 8, 8
+
+    def vcast(t):
+        def one(a):
+            vma = getattr(jax.typeof(a), "vma", frozenset())
+            return a if "pp" in vma else lax.pcast(a, ("pp",),
+                                                   to="varying")
+        return jax.tree_util.tree_map(one, t)
+
+    def ring_row_matmul(x, w):
+        """reduce_scatter(x @ w) as an INNER tp-manual ring — the
+        nested region the wall is about."""
+        def body(xl, wl):
+            n = lax.axis_size("tp")
+            idx = lax.axis_index("tp")
+            m = xl.shape[0]
+            s = m // n
+            acc = jnp.zeros((s,) + xl.shape[1:-1] + (wl.shape[-1],),
+                            xl.dtype)
+            # widen the ring carry to the operands' union vma (the
+            # in-tree ring's _zeros_like_vma fix) — without this the
+            # shallower failure mode (c) fires first
+            union = frozenset().union(
+                *[getattr(jax.typeof(a), "vma", frozenset())
+                  for a in (xl, wl)])
+            need = tuple(union - getattr(jax.typeof(acc), "vma",
+                                         frozenset()))
+            if need:
+                acc = lax.pcast(acc, need, to="varying")
+
+            def step(acc, i):
+                dest = jnp.mod(idx + (n - 1 - i), n)
+                xs = lax.dynamic_slice_in_dim(xl, dest * s, s, 0)
+                acc = acc + xs @ wl
+                return lax.ppermute(
+                    acc, "tp", [(j, (j + 1) % n) for j in range(n)]), None
+
+            acc, _ = lax.scan(step, acc, jnp.arange(n - 1))
+            dest = idx
+            xs = lax.dynamic_slice_in_dim(xl, dest * s, s, 0)
+            return acc + xs @ wl
+
+        # inherit the ambient (pp-manual) mesh context like the
+        # in-tree ring wrappers do (collective_matmul._smap): passing
+        # the concrete mesh trips a SHALLOWER 'context mesh should
+        # match' rejection first; omitting it reaches the documented
+        # vma walls (a)-(c)
+        return shard_map(body, axis_names={"tp"},
+                         in_specs=(P(None, "tp"), P("tp", None)),
+                         out_specs=P("tp", None))(x, w)
+
+    @jax.checkpoint
+    def stage(w, x):
+        h = jax.nn.gelu(x.reshape(B * S, H))
+        return ring_row_matmul(h, w).reshape(B, -1, H)[:, :S // 1] \
+            .reshape(B, S, H)[:, :, :]
+
+    def outer(blocks, x):
+        w = blocks[0]
+
+        def tick(carry, t):
+            _, vjpfn = jax.vjp(
+                lambda xx: stage(w, xx.reshape(B, S, H)).reshape(
+                    B, S, H), carry)
+            (dx,) = vjpfn(vcast(jnp.ones_like(carry)))
+            return vcast(dx), None
+
+        out, _ = lax.scan(tick, vcast(x), jnp.arange(3))
+        return out[None]
+
+    blocks = jnp.ones((2, H, H))
+    x = jnp.ones((B, S, H))
+    try:
+        jax.jit(shard_map(outer, mesh=mesh, axis_names={"pp"},
+                          in_specs=(P("pp"), P(None)),
+                          out_specs=P("pp", None, None, None)))(
+            blocks, x).block_until_ready()
+    except Exception as e:  # noqa: BLE001
+        print("WALL STILL PRESENT — rejection reproduced:")
+        print(f"  {type(e).__name__}: {str(e)[:400]}")
+        return 0
+    print("WALL CLEARED: the nested tp-manual ring under a pp-manual "
+          "vjp'd scan now compiles. Flip gpt_hybrid._use_cm's pp==1 "
+          "gate and planner.collective_matmul.")
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -11,9 +11,19 @@ This probe separates the failure axes:
   B. manual over {'pp'} only, tp GSPMD-auto inside: a tp-sharded
      matmul inside the cond branch (the round-4 configuration)
   C. control: same as A with the psum hoisted OUT of the cond
+  D. sp-style all_gather + psum_scatter inside the cond branch
+  E. ppermute('tp') inside a pp-DIVERGENT cond branch — DEADLOCKS:
+     unlike psum/all_gather/reduce_scatter (lowered with SUBGROUP
+     replica_groups), ppermute lowers to ONE collective-permute whose
+     source-target pairs span the WHOLE mesh (every pp row's tp pairs
+     merged), so idle pp stages never arrive. This is why the ring
+     collective matmuls are restricted to the lockstep 1F1B route and
+     refused under the cond-gated zero-bubble schedules.
 
 Each leg runs under a hard alarm; a leg that trips the alarm is
-recorded as DEADLOCK rather than hanging the probe.
+recorded as DEADLOCK rather than hanging the probe. Leg E additionally
+takes the whole process down after printing (XLA's rendezvous
+termination timeout LOG(FATAL)s) — run it last / expect a crash tail.
 """
 import os
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -217,11 +227,44 @@ def leg_d():
     return f"OK sum={float(r.sum()):.0f}"
 
 
+def leg_e():
+    """ppermute over tp inside a pp-DIVERGENT cond: expected DEADLOCK
+    (whole-mesh collective-permute lowering; see module docstring)."""
+    def body(x):
+        s = lax.axis_index("pp")
+
+        def tick(c, t):
+            def active():
+                return _v(("pp", "tp"),
+                          lax.ppermute(c, "tp",
+                                       [(0, 1), (1, 0)]))
+
+            def idle():
+                return _v(("pp", "tp"), jnp.zeros((H, H), c.dtype))
+
+            y = lax.cond(s == 0, active, idle)  # divergent over pp
+            y = lax.ppermute(y, "pp",
+                             [(i, (i + 1) % 2) for i in range(2)])
+            return y, None
+
+        out, _ = lax.scan(tick, _v(("pp", "tp"), x), jnp.arange(2))
+        return lax.psum(out, ("pp", "tp")) / 4
+
+    x = jnp.ones((H, H), jnp.float32)
+    fn = jax.jit(shard_map(
+        body, mesh=mesh, axis_names={"pp", "tp"},
+        in_specs=(P(),), out_specs=P()))
+    r = fn(x)
+    r.block_until_ready()
+    return f"OK sum={float(r.sum()):.0f} (unexpected: wall cleared?)"
+
+
 if __name__ == "__main__":
     for name, leg in [("A manual-psum-in-cond", leg_a),
                       ("B gspmd-auto-in-cond", leg_b),
                       ("C psum-hoisted", leg_c),
-                      ("D sp-gather-scatter-in-cond", leg_d)]:
+                      ("D sp-gather-scatter-in-cond", leg_d),
+                      ("E ppermute-in-divergent-cond", leg_e)]:
         try:
             r = _with_alarm(leg, 60)
         except Exception as e:  # noqa: BLE001

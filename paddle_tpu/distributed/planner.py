@@ -86,11 +86,13 @@ class PlanCandidate:
     @property
     def collective_matmul(self) -> bool:
         """Ring-overlap knob for the sp matmuls: recommended whenever
-        the plan sequence-parallelizes over a real tp axis at pp==1
-        (pp>1 remains blocked by a Shardy nesting wall, re-confirmed
-        round 4 with a canary reproducer — gpt_hybrid._use_cm).
-        Consumed by to_parallel_config()."""
-        return self.sp and self.tp > 1 and self.pp == 1
+        the plan sequence-parallelizes over a real tp axis. At pp==1
+        the GSPMD engine runs the ring via a top-level tp shard_map;
+        at pp>1 it rides the manual-tp stage body (round 5 —
+        models/gpt_manual_tp.py; the nested-region formulation stays
+        Shardy-walled, benchmarks/_cm_repro.py). Consumed by
+        to_parallel_config()."""
+        return self.sp and self.tp > 1
 
     def to_parallel_config(self, zero_bubble: bool = False,
                            **overrides):
@@ -109,8 +111,10 @@ class PlanCandidate:
         enumerator already guarantees this for planner-built plans) and
         — under sp — seq_len % tp == 0 (the planner cannot know the
         batch shape; pick 1f1b or pad the sequence if your seq length
-        does not divide tp). The collective-matmul ring is incompatible
-        but never coincides (a pp==1 construct)."""
+        does not divide tp). The collective-matmul ring cannot ride the
+        cond-gated zero-bubble phases (whole-mesh ppermute), so a
+        zero-bubble choice drops it — see the conflict resolution
+        below."""
         from paddle_tpu.models.gpt_hybrid import ParallelConfig
         if isinstance(zero_bubble, str) and \
                 zero_bubble not in ("zbh1", "zbvpp"):
@@ -126,6 +130,21 @@ class PlanCandidate:
                   remat=self.remat, zero1=self.zero >= 1,
                   collective_matmul=self.collective_matmul)
         kw.update(overrides)
+        # Resolve knob conflicts AFTER overrides (the final schedule /
+        # final fused_ce win; an explicit collective_matmul override is
+        # honored as given):
+        # - zero-bubble precludes the ring (its cond-gated phases
+        #   cannot host the ring's whole-mesh ppermute — gpt_hybrid
+        #   _validate_pp_schedule);
+        # - at pp>1 the ring rides the manual-tp route, which has no
+        #   fused-CE form: with fused_ce on (the default), the fused
+        #   CE's memory win outranks the ring overlap, so the ring is
+        #   dropped; pass fused_ce=False to take the ring instead.
+        if "collective_matmul" not in overrides:
+            fce = overrides.get("fused_ce", ParallelConfig.fused_ce)
+            if kw["pp_schedule"] in ("zbh1", "zbvpp") or (
+                    kw["collective_matmul"] and kw["pp"] > 1 and fce):
+                kw["collective_matmul"] = False
         return ParallelConfig(**kw)
 
     def short(self) -> str:

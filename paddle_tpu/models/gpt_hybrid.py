@@ -84,7 +84,12 @@ class ParallelConfig:
     # .py; the reference overlaps these with CUDA streams,
     # sequence_parallel_utils.py:240-340). Opt-in: wins only when the
     # gather/scatter is bandwidth-bound on real multi-chip ICI.
-    # Applies at pp==1 only (Shardy nesting wall — see _use_cm)
+    # pp==1: GSPMD route via a top-level tp shard_map (_use_cm).
+    # pp>1 (round 5): manual-tp 1F1B route — needs sp, tp>1,
+    # vpp_chunks=1, no MoE, fused_ce=False (the nested-region
+    # formulation stays Shardy-walled, benchmarks/_cm_repro.py).
+    # Incompatible with the zero-bubble schedules (whole-mesh ppermute
+    # in a cond-gated phase — _validate_pp_schedule refuses)
     collective_matmul: bool = False
     zero1: bool = True        # shard adam moments over dp
     # Adam moment storage dtype. None (default) INHERITS the param
@@ -639,10 +644,24 @@ def _train_grads_1f1b(params, batch, cfg, pcfg, mesh):
     from paddle_tpu.parallel.pipeline import pipeline_microbatch
     from paddle_tpu.parallel.pipeline_1f1b import pipeline_train_1f1b
 
-    if pcfg.pp_schedule in ("zbh1", "zbvpp") and pcfg.tp > 1:
-        # zero-bubble under tp>1: the cond-gated phases need EXPLICIT
-        # tp collectives (manual axis) — GSPMD-auto ones deadlock
-        # in-branch (round-4 wall; round-5 manual-tp formulation)
+    use_manual_tp = pcfg.tp > 1 and pcfg.num_experts == 0 and (
+        pcfg.pp_schedule in ("zbh1", "zbvpp")
+        or (pcfg.pp_schedule == "1f1b" and pcfg.vpp_chunks == 1
+            and pcfg.collective_matmul and pcfg.sp
+            # fused_ce has no manual-tp form: when BOTH the fused CE
+            # and the ring are requested, the fused CE's memory win
+            # (never materializing [T, V] logits) outranks the ring
+            # overlap — keep the GSPMD route (the nonroutable warning
+            # in _validate_pp_schedule names the trade)
+            and not pcfg.fused_ce))
+    if use_manual_tp:
+        # manual-tp stage body (models/gpt_manual_tp.py):
+        # - zero-bubble under tp>1: the cond-gated phases need EXPLICIT
+        #   tp collectives — GSPMD-auto ones deadlock in-branch
+        #   (round-4 wall; round-5 manual-tp formulation);
+        # - 1F1B + collective_matmul + sp at pp>1: the ring collective
+        #   matmuls need tp manual at the SAME level as pp (the nested
+        #   formulation is Shardy-walled, benchmarks/_cm_repro.py)
         from paddle_tpu.models.gpt_manual_tp import \
             train_grads_zb_manual_tp
         return train_grads_zb_manual_tp(params, batch, cfg, pcfg, mesh)
@@ -745,15 +764,35 @@ def _validate_pp_schedule(pcfg):
             "5 — the stage body switches to the manual-tp formulation "
             "with explicit in-branch collectives "
             "(models/gpt_manual_tp.py). Use '1f1b' for EP hybrids.")
-    if pcfg.pp_schedule in ("zbh1", "zbvpp") and pcfg.tp > 1 \
-            and pcfg.collective_matmul:
-        raise ValueError(
-            "zero-bubble schedules use the manual-tp stage body, which "
-            "does not take the collective-matmul ring path (that ring "
-            "is a pp==1 construct anyway — _use_cm)")
     if pcfg.pp_schedule == "zbvpp" and pcfg.pp <= 1:
         raise ValueError("pp_schedule='zbvpp' requires pp > 1 (the "
                          "V placement spans a pipeline ring)")
+    if pcfg.pp_schedule in ("zbh1", "zbvpp") and pcfg.tp > 1 \
+            and pcfg.collective_matmul:
+        raise ValueError(
+            "collective_matmul does not compose with the zero-bubble "
+            "schedules: the ring's tp ppermute lowers to ONE "
+            "collective-permute spanning the whole mesh, and inside a "
+            "cond-gated phase the idle pipeline stages never reach it "
+            "(cross-matched data or rendezvous deadlock — "
+            "benchmarks/_r5_cond_collective_probe.py leg E). Use "
+            "pp_schedule='1f1b' for the ring under pp>1, or drop "
+            "collective_matmul for zero-bubble.")
+    if pcfg.collective_matmul and pcfg.pp > 1 and not (
+            pcfg.pp_schedule == "1f1b" and pcfg.vpp_chunks == 1
+            and pcfg.sp and pcfg.tp > 1 and pcfg.num_experts == 0
+            and not pcfg.fused_ce):
+        # the ring at pp>1 rides the manual-tp 1F1B route only; for
+        # every other pp>1 shape the knob has no effect — say so
+        # instead of silently running without the overlap the planner
+        # cost model assumed
+        import warnings
+        warnings.warn(
+            "collective_matmul requested but not routable at pp>1 "
+            "(needs pp_schedule='1f1b', vpp_chunks=1, sp=True, tp>1, "
+            "no MoE, fused_ce=False — the manual-tp route; with "
+            "fused_ce=True the fused-CE memory win keeps the GSPMD "
+            "route); running WITHOUT the ring overlap", stacklevel=3)
 
 
 def build_train_step(cfg: GPTConfig, pcfg: ParallelConfig, mesh: Mesh,

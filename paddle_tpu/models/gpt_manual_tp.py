@@ -61,11 +61,26 @@ def block_manual_tp(x, lp, cfg: GPTConfig, pcfg, tp_axis="tp"):
     after each row-parallel matmul strips tp-variance).
     sp: x [b, s/tp, h] tp-varying; all_gather before the column
     matmuls, psum_scatter after the row matmuls (Megatron-LM SP).
+    sp + collective_matmul: the gather/matmul and matmul/scatter pairs
+    become ring collective matmuls (collective_matmul.sp_*_matmul_local
+    — tp is ALREADY manual here, so no nested region and no Shardy
+    wall: this is how collective-matmul overlap reaches pp>1, closing
+    the round-4 'cm under pp' hole; the GSPMD engines' nested
+    formulation stays walled, see benchmarks/_cm_repro.py).
     All collectives are explicit and legal inside the zero-bubble
     cond-gated phases (tp-uniform predicates).
     """
     from jax.ad_checkpoint import checkpoint_name
     sp = pcfg.sp
+    # ring collective matmuls ONLY on the lockstep 1F1B route: ppermute
+    # lowers to ONE collective-permute spanning the whole mesh (the tp
+    # pairs of every pp row merged into a single op), so inside a
+    # cond-gated zero-bubble phase the idle pp stages never arrive and
+    # the op cross-matches or deadlocks (round-5 probe:
+    # benchmarks/_r5_cond_collective_probe.py leg E). psum/all_gather/
+    # psum_scatter lower to SUBGROUP replica_groups and stay legal.
+    cm = bool(pcfg.collective_matmul) and sp \
+        and pcfg.pp_schedule == "1f1b"
     nh_local = cfg.num_heads // pcfg.tp
 
     def gather(h):
@@ -78,24 +93,42 @@ def block_manual_tp(x, lp, cfg: GPTConfig, pcfg, tp_axis="tp"):
                                     tiled=True)
         return lax.psum(part, tp_axis)
 
-    hres = x
-    hx = gather(_ln(x, lp["ln1_g"], lp["ln1_b"]))
-    qkv = checkpoint_name(
-        jnp.einsum("bsh,hkj->bskj", hx, lp["qkv_w"])
-        + lp["qkv_b"], "qkv")
     from paddle_tpu.models.gpt_hybrid import _attend
+    if cm:
+        from paddle_tpu.parallel.collective_matmul import (
+            sp_column_matmul_local, sp_row_matmul_local)
+
+        def column(hx_local, w):        # [.., sl, K] x [K, Fl] -> [.., s, Fl]
+            return sp_column_matmul_local(hx_local, w, tp_axis)
+
+        def row(full, w):               # [.., s, Kl] x [Kl, F] -> [.., sl, F]
+            return sp_row_matmul_local(full, w, tp_axis)
+    else:
+        def column(hx_local, w):
+            return gather(hx_local) @ w
+
+        def row(full, w):
+            return reduce_out(full @ w)
+
+    h = x.shape[-1]
+    hres = x
+    hx = _ln(x, lp["ln1_g"], lp["ln1_b"])
+    qkv = checkpoint_name(
+        column(hx, lp["qkv_w"].reshape(h, -1))
+        .reshape(hx.shape[0], -1, 3, lp["qkv_w"].shape[-1])
+        + lp["qkv_b"], "qkv")
     attn = checkpoint_name(
         _attend(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], nh_local),
         "attn_out")
     attn = checkpoint_name(
-        reduce_out(attn @ lp["proj_w"]) + lp["proj_b"], "proj")
+        row(attn, lp["proj_w"]) + lp["proj_b"], "proj")
     x = hres + attn
     hres = x
-    hx = gather(_ln(x, lp["ln2_g"], lp["ln2_b"]))
+    hx = _ln(x, lp["ln2_g"], lp["ln2_b"])
     ff = checkpoint_name(
-        reduce_out(jax.nn.gelu(checkpoint_name(
-            hx @ lp["fc1_w"] + lp["fc1_b"], "ffn1")) @ lp["fc2_w"])
-        + lp["fc2_b"], "ffn2")
+        row(jax.nn.gelu(checkpoint_name(
+            column(hx, lp["fc1_w"]) + lp["fc1_b"], "ffn1")),
+            lp["fc2_w"]) + lp["fc2_b"], "ffn2")
     return hres + ff
 
 
@@ -236,7 +269,7 @@ def train_grads_zb_manual_tp(params, batch, cfg: GPTConfig, pcfg, mesh):
     same return contract."""
     from paddle_tpu.parallel.pipeline import pipeline_microbatch
     from paddle_tpu.parallel.pipeline_1f1b import (
-        pipeline_train_zbh1, pipeline_train_zbvpp)
+        pipeline_train_1f1b, pipeline_train_zbh1, pipeline_train_zbvpp)
     from paddle_tpu.models.gpt_hybrid import _constrain
 
     input_ids, labels = batch
@@ -255,16 +288,34 @@ def train_grads_zb_manual_tp(params, batch, cfg: GPTConfig, pcfg, mesh):
             in os.environ.get("XLA_FLAGS", ""):
         # fail fast with a diagnosis instead of a 40s rendezvous-
         # timeout crash: XLA:CPU's concurrency-optimized thunk
-        # scheduler issues the in-branch manual collectives in
+        # scheduler issues data-independent manual collectives in
         # divergent per-device orders and deadlocks (round-5 finding;
-        # TPU executes one uniform program order and is unaffected)
+        # TPU executes one uniform program order and is unaffected).
+        # Applies to every manual-tp pipeline route — the cond-gated
+        # zero-bubble schedules AND the lockstep ring-collective-matmul
+        # 1F1B (whose many data-independent ring steps race the same
+        # way).
         raise RuntimeError(
-            "zero-bubble schedules with tp>1 on the XLA:CPU backend "
-            "require XLA_FLAGS to include "
+            "manual-tp pipeline stage bodies (zero-bubble with tp>1, "
+            "or 1F1B with collective_matmul at pp>1) on the XLA:CPU "
+            "backend require XLA_FLAGS to include "
             "--xla_cpu_enable_concurrency_optimized_scheduler=false "
             "(set before jax initializes); the concurrency-optimized "
-            "thunk scheduler deadlocks the manual-tp in-branch "
-            "collectives' rendezvous")
+            "thunk scheduler deadlocks the manual collectives' "
+            "rendezvous")
+    if pcfg.fused_ce:
+        # the manual head is the (unfused) vocab-parallel CE: the
+        # fused chunked LM-head+CE kernel assumes a replicated wte and
+        # GSPMD sharding, neither of which holds in the manual region.
+        # Warn rather than refuse — fused_ce defaults True and the
+        # math is identical; only the [T, V/tp] logits materialization
+        # differs.
+        import warnings
+        warnings.warn(
+            "fused_ce is not available on the manual-tp pipeline "
+            "route; using the vocab-parallel CE head (identical math, "
+            "materializes [tokens, vocab/tp] logits per microbatch)",
+            stacklevel=3)
 
     def embed(wte, wpe):
         return wte[input_ids].astype(cdt) + wpe[:s][None].astype(cdt)
@@ -314,6 +365,13 @@ def train_grads_zb_manual_tp(params, batch, cfg: GPTConfig, pcfg, mesh):
             return pipeline_train_zbvpp(stage_fn, blocks, mb, last_grad,
                                         head_params=head_params,
                                         serialize_phases=True)
+        if pcfg.pp_schedule == "1f1b":
+            # lockstep 1F1B with the manual-tp body: no cond-gated
+            # phases, so collectives are unconditional and need no
+            # serialization — this is the route that gives the ring
+            # collective matmuls pp>1 composition
+            return pipeline_train_1f1b(stage_fn, blocks, mb, last_grad,
+                                       head_params=head_params)
         return pipeline_train_zbh1(stage_fn, blocks, mb, last_grad,
                                    head_params=head_params,
                                    serialize_phases=True)

@@ -55,6 +55,23 @@ def _vma_of(x) -> frozenset:
     return getattr(jax.typeof(x), "vma", frozenset())
 
 
+def _make_za(x_microbatches, axis_name):
+    """Factory for the activation-typed-zeros helper shared by every
+    pipeline variant: vma = x_microbatches' vma + the pipeline axis
+    (manual-tp callers feed tp-varying activations under sp, so cond
+    branches / scan carries / vjp cotangents built from zeros must
+    match that type, not just the pipeline axis)."""
+    x_shape = x_microbatches.shape[1:]
+    dtype = x_microbatches.dtype
+
+    def _za(shape=None, dt=None):
+        return _zeros_matching_vma(
+            x_microbatches, shape=x_shape if shape is None else shape,
+            dtype=dtype if dt is None else dt, extra=(axis_name,))
+
+    return _za
+
+
 def _zeros_matching_vma(ref, shape=None, dtype=None, extra=()):
     """Fresh zeros whose varying-manual-axes type matches `ref`'s vma
     (plus `extra` axes). Zero literals start unvarying on every manual
@@ -172,17 +189,20 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params, x_microbatches,
 
     x_shape = x_microbatches.shape[1:]
     dtype = x_microbatches.dtype
-    act0 = _varying(jnp.zeros(x_shape, dtype))
-    cot0 = _varying(jnp.zeros(x_shape, dtype))
-    stash0 = _varying(jnp.zeros((k,) + x_shape, dtype))
+    _za = _make_za(x_microbatches, axis_name)
+    act0 = _za()
+    cot0 = _za()
+    stash0 = _za((k,) + x_shape)
     grads0 = jax.tree_util.tree_map(
-        lambda p: _varying(jnp.zeros(p.shape, grad_dtype)), my_params)
+        lambda p: _zeros_matching_vma(p, dtype=grad_dtype,
+                                      extra=(axis_name,)), my_params)
     # structure probe (unused outputs are DCE'd by XLA)
-    _, _, probe_hg = last_stage_grad(jnp.zeros(x_shape, dtype),
-                                     head_params_v, jnp.zeros((), jnp.int32))
+    _, _, probe_hg = last_stage_grad(_za(), head_params_v,
+                                     jnp.zeros((), jnp.int32))
     head0 = None if probe_hg is None else jax.tree_util.tree_map(
-        lambda g: _varying(jnp.zeros(g.shape, grad_dtype)), probe_hg)
-    dx0_buf0 = _varying(jnp.zeros((m,) + x_shape, dtype))
+        lambda g: _zeros_matching_vma(g, dtype=grad_dtype,
+                                      extra=(axis_name,)), probe_hg)
+    dx0_buf0 = _za((m,) + x_shape)
 
     def tick(carry, t):
         act_in, cot_in, stash, grads, head, loss, dx0_buf = carry
@@ -591,16 +611,7 @@ def pipeline_train_zbh1(stage_fn: Callable, stage_params, x_microbatches,
 
     x_shape = x_microbatches.shape[1:]
     dtype = x_microbatches.dtype
-
-    def _za(shape=None, dt=None):
-        """Activation-typed zeros: vma = x_microbatches' vma + the
-        pipeline axis. Under a manual-tp caller with sp, activations
-        are tp-varying (sequence-sharded); without, tp-invarying — the
-        idle cond branches and carries must match either way."""
-        return _zeros_matching_vma(
-            x_microbatches, shape=x_shape if shape is None else shape,
-            dtype=dtype if dt is None else dt, extra=(axis_name,))
-
+    _za = _make_za(x_microbatches, axis_name)
     act0 = _za()
     cot0 = _za()
     stash0 = _za((k,) + x_shape)
@@ -873,14 +884,7 @@ def pipeline_train_zbvpp(stage_fn: Callable, stage_params,
 
     x_shape = x_microbatches.shape[1:]
     dtype = x_microbatches.dtype
-
-    def _za(shape=None, dt=None):
-        """Activation-typed zeros matching x_microbatches' vma (+ the
-        pipeline axis) — see pipeline_train_zbh1."""
-        return _zeros_matching_vma(
-            x_microbatches, shape=x_shape if shape is None else shape,
-            dtype=dtype if dt is None else dt, extra=(axis_name,))
-
+    _za = _make_za(x_microbatches, axis_name)
     zact = _za
     grads0 = jax.tree_util.tree_map(
         lambda p: _zeros_matching_vma(p, dtype=grad_dtype,

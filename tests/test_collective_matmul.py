@@ -210,15 +210,25 @@ def test_hybrid_engine_collective_matmul_loss_parity():
 
 def test_cm_under_pp_upstream_wall():
     """CANARY (VERDICT r3 item 5 negative result): collective matmul
-    under pp>1 needs an inner tp-manual region whose operands vary over
-    the outer pp axis; Shardy's verifier rejects the combination when a
-    remat'd ring runs under the pp scan's vjp ('manual axes must come
-    before free axes' — rank-1 operands squash vma {pp, tp} onto one
-    dim). THIS TEST ASSERTS THE REJECTION STILL HAPPENS: when a jax
-    upgrade makes it pass, flip gpt_hybrid._use_cm's pp==1 gate and the
-    planner's collective_matmul property, and turn this into a parity
-    test. Minimal structure: jax.checkpoint(stage-with-tp-ring) under
-    scan + vjp inside a pp-manual region."""
+    under pp>1 via a NESTED region needs an inner tp-manual shard_map
+    whose operands vary over the outer pp axis; Shardy's verifier
+    rejects the combination when a remat'd ring runs under the pp
+    scan's vjp ('manual axes must come before free axes' — rank-1
+    operands squash vma {pp, tp} onto one dim). THIS TEST ASSERTS THE
+    REJECTION STILL HAPPENS: when a jax upgrade makes it pass, flip
+    gpt_hybrid._use_cm's pp==1 gate and the planner's
+    collective_matmul property, and turn this into a parity test.
+    Minimal structure: jax.checkpoint(stage-with-tp-ring) under scan +
+    vjp inside a pp-manual region. A standalone upstreamable
+    reproducer of the same wall (with the shallower failure modes
+    peeled off) lives in benchmarks/_cm_repro.py.
+
+    Round-5 note: the CAPABILITY is delivered under pp>1 anyway by the
+    manual-tp stage body (tp manual at the SAME level as pp, ring via
+    collective_matmul.sp_*_matmul_local, no nested region —
+    models/gpt_manual_tp.py); this canary tracks only the upstream
+    limit of the nested formulation the GSPMD-auto engines would
+    need."""
     import jax
     import jax.numpy as jnp
     from jax import lax

@@ -64,12 +64,15 @@ def _run(pcfg, cfg=CFG):
     }
 
 
-def _parity(sched, sp, dp=1, cfg=CFG):
+def _parity(sched, sp, dp=1, cfg=CFG, cm=False):
     pk = dict(dp=dp, tp=2, pp=2, sp=sp, microbatches=4,
               param_dtype=jnp.float32, compute_dtype=jnp.float32,
               fused_ce=False, remat=True)
+    # the oracle is always the GSPMD-auto 1F1B (no ring): the ring
+    # collective matmuls must compute the same function
     rl, rg = _run(GH.ParallelConfig(pp_schedule="1f1b", **pk), cfg)
-    zl, zg = _run(GH.ParallelConfig(pp_schedule=sched, **pk), cfg)
+    zl, zg = _run(GH.ParallelConfig(pp_schedule=sched,
+                                    collective_matmul=cm, **pk), cfg)
     np.testing.assert_allclose(zl, rl, rtol=2e-5)
     for k in rg:
         np.testing.assert_allclose(zg[k], rg[k], rtol=2e-4, atol=2e-5,
@@ -130,3 +133,23 @@ def test_zbh1_tp2_nondivisible_vocab_pads():
     cfg63 = GPTConfig(vocab_size=63, hidden_size=32, num_layers=4,
                       num_heads=4, max_seq_len=32, ffn_mult=2)
     _parity("zbh1", False, cfg=cfg63)
+
+
+def test_collective_matmul_under_pp_via_manual_tp():
+    """The round-4 'cm under pp>1' hole, closed for the LOCKSTEP 1F1B
+    route: ring collective matmuls (sp_*_matmul_local) inside the
+    manual-tp stage body — tp manual at the same level as pp, no
+    nested region, so the Shardy wall (benchmarks/_cm_repro.py) does
+    not apply. The cond-gated zero-bubble schedules cannot host the
+    ring (ppermute lowers to a whole-mesh op; idle stages never
+    arrive — probe leg E) and must refuse it with a diagnosis."""
+    _parity("1f1b", True, cm=True)
+    with pytest.raises(ValueError, match="collective_matmul"):
+        GH._validate_pp_schedule(GH.ParallelConfig(
+            dp=1, tp=2, pp=2, sp=True, microbatches=4,
+            pp_schedule="zbh1", collective_matmul=True))
+    # planner precedence: zero_bubble wins, the ring is dropped
+    from paddle_tpu.distributed.planner import PlanCandidate
+    pc = PlanCandidate(dp=1, tp=2, pp=2, sp=True, microbatches=4)
+    cfgzb = pc.to_parallel_config(zero_bubble=True)
+    assert cfgzb.pp_schedule == "zbh1" and not cfgzb.collective_matmul

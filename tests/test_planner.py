@@ -148,10 +148,19 @@ def test_plan_to_parallel_config_carries_collective_matmul():
     pcfg = p.to_parallel_config()
     assert pcfg.collective_matmul and pcfg.zero1 and pcfg.tp == 4
     assert "+cm" in p.short()
+    # pp>1: the ring rides the manual-tp route, which has no fused-CE
+    # form — with fused_ce on (the default) the memory win outranks
+    # the overlap and cm is dropped; fused_ce=False takes the ring
     p2 = PlanCandidate(dp=1, tp=4, pp=2, sp=True, microbatches=4)
     pcfg2 = p2.to_parallel_config(remat=False)
-    assert not pcfg2.collective_matmul and pcfg2.pp_schedule == "1f1b"
-    assert pcfg2.remat is False
+    assert not pcfg2.collective_matmul and pcfg2.fused_ce
+    pcfg2r = p2.to_parallel_config(remat=False, fused_ce=False)
+    assert pcfg2r.collective_matmul and pcfg2r.pp_schedule == "1f1b"
+    assert pcfg2r.remat is False
+    # no sp -> no ring
+    p3 = PlanCandidate(dp=1, tp=4, pp=2, sp=False, microbatches=4)
+    assert not p3.to_parallel_config(
+        fused_ce=False).collective_matmul
 
 
 def test_plan_to_parallel_config_zero_bubble_knob():
