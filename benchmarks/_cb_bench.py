@@ -1,0 +1,71 @@
+"""On-chip continuous-batching throughput probe (round 5).
+
+Drives ContinuousBatchingSession on the real TPU with a stream of
+overlapping requests (Poisson-ish staggered lengths/budgets) and
+reports aggregate generated tokens/sec, vs the static-batch
+DecodeSession on the same model as the ceiling.
+
+Run ON TPU (no env overrides — let axon provide the chip):
+    PYTHONPATH=/root/repo python benchmarks/_cb_bench.py
+"""
+import os
+import time
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.decode import (ContinuousBatchingSession,
+                                         DecodeSession)
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+HID = int(os.environ.get("CB_HID", "1024"))
+LAYERS = int(os.environ.get("CB_LAYERS", "12"))
+SLOTS = int(os.environ.get("CB_SLOTS", "8"))
+CAP = int(os.environ.get("CB_CAP", "512"))
+NREQ = int(os.environ.get("CB_NREQ", "32"))
+
+cfg = LlamaConfig(vocab_size=32000, hidden_size=HID,
+                  intermediate_size=HID * 4 // 3 // 64 * 64 * 2,
+                  num_layers=LAYERS, num_heads=HID // 64,
+                  num_kv_heads=HID // 64, max_seq_len=CAP)
+paddle.seed(0)
+model = LlamaForCausalLM(cfg)
+rng = np.random.RandomState(0)
+
+pmax = max(CAP // 4, 8)
+reqs = [(rng.randint(0, 32000, (int(rng.randint(pmax // 4, pmax)),))
+         .astype(np.int32), int(rng.randint(pmax // 2, pmax)))
+        for _ in range(NREQ)]
+total_new = sum(b for _, b in reqs)
+
+SYNC = int(os.environ.get("CB_SYNC", "8"))
+sess = ContinuousBatchingSession(model, max_slots=SLOTS,
+                                 max_length=CAP, sync_every=SYNC)
+for ids, budget in reqs[:SLOTS]:
+    sess.submit(ids, budget)
+# warm both executables
+sess.step()
+
+for ids, budget in reqs[SLOTS:]:
+    sess.submit(ids, budget)
+t0 = time.perf_counter()
+out = sess.run()
+dt = time.perf_counter() - t0
+done_new = sum(len(v) - len(reqs[i][0]) for i, v in out.items())
+print(f"continuous batching: {done_new} tokens in {dt:.2f}s = "
+      f"{done_new / dt:.1f} tok/s "
+      f"(slots={SLOTS}, cap={CAP}, {NREQ} requests, "
+      f"sync_every={SYNC})")
+print(f"executables: admit={sess.executable_counts()[0]} "
+      f"decode={sess.executable_counts()[1]}")
+
+# static-batch ceiling: same model, batch SLOTS, uniform length
+ds = DecodeSession(model, CAP)
+plen, gnew = max(CAP // 8, 4), max(CAP // 8, 4)
+ids = paddle.to_tensor(rng.randint(0, 32000, (SLOTS, plen)))
+ds.generate(ids, max_new_tokens=4)  # warm
+t0 = time.perf_counter()
+ds.generate(ids, max_new_tokens=gnew)
+dt = time.perf_counter() - t0
+print(f"static-batch ceiling: {SLOTS * gnew / dt:.1f} tok/s "
+      f"(B={SLOTS}, {gnew} new)")

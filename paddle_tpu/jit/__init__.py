@@ -284,7 +284,8 @@ def _float_thrash(new, old):
 
 class StaticFunction:
     def __init__(self, fn, objs=None, donate_states=True, backend=None,
-                 input_spec=None, pad_dynamic_dims=False):
+                 input_spec=None, pad_dynamic_dims=False,
+                 pad_mask_arg=None):
         self._fn = fn
         self._objs = objs
         self._donate = donate_states
@@ -302,19 +303,37 @@ class StaticFunction:
         # sizes in a bucket — the decode-prefill bucketing discipline
         # generalized; outputs carrying the first dynamic dim's bucket
         # size on axis 0 are sliced back to the true size. Padded rows
-        # flow through the function, so this mode is for row-
-        # independent (inference-style) fns and refuses stateful
+        # flow through the function, so by default this mode is for
+        # row-independent (inference-style) fns and refuses stateful
         # train-step objs.
+        #
+        # pad_mask_arg="name" (round 5) lifts that refusal for TRAIN
+        # steps: the call injects a float mask keyword argument `name`
+        # of shape [bucket] — 1.0 on true positions of the FIRST
+        # dynamic dim, 0.0 on padding — and the function contract is to
+        # use it as the loss weight (e.g. sum(w*loss)/sum(w), the fused
+        # CE's token-weight input). Pad positions then carry exactly
+        # zero loss weight, so grads — and therefore the optimizer/
+        # scaler state — match the unpadded run; the state stays
+        # static-shaped across buckets (the reference's training-side
+        # symbolic shapes, PIR shape dialect / InferSymbolicShape).
+        # Right-padding is exact for causal models (pad positions are
+        # never attended by true ones); non-causal models must also
+        # mask attention themselves.
         self._dyn_dims = self._parse_dynamic_dims(input_spec)
         self._pad_dynamic = bool(pad_dynamic_dims)
+        self._pad_mask_arg = pad_mask_arg
         if self._pad_dynamic and not self._dyn_dims:
             raise ValueError(
                 "pad_dynamic_dims=True needs an input_spec with "
                 "None/-1 dims to know which axes to bucket")
+        if pad_mask_arg is not None and not self._pad_dynamic:
+            raise ValueError(
+                "pad_mask_arg requires pad_dynamic_dims=True")
         self._shape_family = set()
         self._shape_overflow = False
         self._slice_plans = {}
-        if self._pad_dynamic:
+        if self._pad_dynamic and pad_mask_arg is None:
             check_objs = objs
             if check_objs is None:
                 owner = getattr(fn, "__self__", None)
@@ -324,8 +343,10 @@ class StaticFunction:
                 raise ValueError(
                     "pad_dynamic_dims pads rows through the function, "
                     "which would corrupt stateful (optimizer/scaler) "
-                    "updates — use exact dynamic shapes "
-                    "(pad_dynamic_dims=False) for train steps")
+                    "updates — pass pad_mask_arg='<kwarg name>' and "
+                    "weight the loss by that mask for bucketed TRAIN "
+                    "steps, or use exact dynamic shapes "
+                    "(pad_dynamic_dims=False)")
         functools.update_wrapper(self, fn, updated=[])
         _static_functions.add(self)
 
@@ -369,7 +390,12 @@ class StaticFunction:
     def _pad_args(self, arg_arrays):
         """Pad every dynamic dim to its power-of-two bucket; returns
         (padded arrays, (true size, padded size) of the first dynamic
-        dim)."""
+        dim). Padding runs in NumPy on host: an eager jnp.pad would
+        compile one tiny executable per DISTINCT true length (the pad
+        widths are part of the shape signature), defeating the
+        bucketing's whole point of a bounded executable set — asserted
+        by the compile-event counter in
+        tests/test_symbolic_shapes.py::test_pad_mask_bucketed_train_*."""
         arrays = list(arg_arrays)
         first = None
         for li, di, true in self._dyn_sizes(arg_arrays):
@@ -380,16 +406,24 @@ class StaticFunction:
             if pad:
                 widths = [(0, 0)] * a.ndim
                 widths[di] = (0, pad)
-                arrays[li] = jnp.pad(a, widths)
+                arrays[li] = jnp.asarray(
+                    np.pad(np.asarray(a), widths))
         return arrays, first
 
-    def _slice_plan(self, meta, unpadded_arrays, true, padded):
+    def _slice_plan(self, meta, unpadded_arrays, true, padded,
+                    state=None):
         """Which output leaves actually DERIVE their axis 0 from the
         padded dim: shape-trace the fn on the UNPADDED abstract inputs
         (jax.eval_shape — no compute) and mark leaves whose dim 0 is
         the true (unpadded) size. A size-equality heuristic alone would
         also truncate batch-independent outputs that coincidentally
-        carry the bucket size on axis 0."""
+        carry the bucket size on axis 0.
+
+        `state`: the resolved state tensors when the fn is a STATEFUL
+        train step (pad_mask_arg mode) — the probe traces the whole
+        step, so the optimizer/param mutations write eval_shape tracers
+        into Tensor._data; snapshot and restore around the probe or the
+        tracers escape and poison the next real call."""
         key = (meta[0], tuple(a.shape for a in unpadded_arrays))
         if key in self._slice_plans:
             return self._slice_plans[key]
@@ -400,6 +434,7 @@ class StaticFunction:
             arrs, _ = _flatten_out(out)
             return tuple(arrs)
 
+        saved = [t._data for t in state] if state else None
         try:
             abstract = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
                              for a in unpadded_arrays)
@@ -409,6 +444,10 @@ class StaticFunction:
         except Exception:
             # untraceable fn: fall back to the dim0-size heuristic
             plan = None
+        finally:
+            if saved is not None:
+                for t, a in zip(state, saved):
+                    t._data = a
         self._slice_plans[key] = plan
         return plan
 
@@ -463,7 +502,8 @@ class StaticFunction:
                 "dynamic_dims": list(self._dyn_dims),
                 "shape_specializations": sorted(self._shape_family),
                 "shape_overflowed": self._shape_overflow,
-                "pad_dynamic_dims": self._pad_dynamic}
+                "pad_dynamic_dims": self._pad_dynamic,
+                "pad_mask_arg": self._pad_mask_arg}
 
     def __call__(self, *args, **kwargs):
         state = self._resolve_state()
@@ -483,10 +523,38 @@ class StaticFunction:
             if self._pad_dynamic:
                 unpadded = list(arg_arrays)
                 arg_arrays, pad_slice = self._pad_args(arg_arrays)
+                if self._pad_mask_arg is not None and \
+                        pad_slice is not None:
+                    # inject the loss-weight mask for the first
+                    # dynamic dim (1.0 true / 0.0 pad) and re-flatten
+                    # so the mask rides the compiled signature; the
+                    # slice-plan probe gets the matching all-ones mask
+                    # at the TRUE size
+                    true, padded = pad_slice
+                    # NumPy-built mask: an eager jnp comparison against
+                    # the python int `true` would compile per distinct
+                    # length (see _pad_args)
+                    mask = jnp.asarray(
+                        (np.arange(padded) < true).astype(np.float32))
+                    args_p, kwargs_p = _tree_unflatten_args(
+                        list(arg_arrays), meta)
+                    kwargs_p[self._pad_mask_arg] = Tensor._wrap(
+                        mask, True)
+                    # unpadded probe side uses the PRE-mask meta, then
+                    # gains the matching all-ones mask at the true size
+                    args_u, kwargs_u = _tree_unflatten_args(
+                        list(unpadded), meta)
+                    kwargs_u[self._pad_mask_arg] = Tensor._wrap(
+                        jnp.asarray(np.ones(true, np.float32)), True)
+                    arg_arrays, meta = _tree_flatten_args(
+                        args_p, kwargs_p)
+                    unpadded, _meta_u = _tree_flatten_args(
+                        args_u, kwargs_u)
                 if pad_slice is not None and \
                         pad_slice[0] != pad_slice[1]:
                     pad_plan = self._slice_plan(meta, unpadded,
-                                                *pad_slice)
+                                                *pad_slice,
+                                                state=state)
                 args, kwargs = _tree_unflatten_args(arg_arrays, meta)
             else:
                 dyn_key = tuple(
@@ -758,6 +826,7 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     methods). Compile a whole train step by passing [model, optimizer].
     """
     pad_dynamic_dims = kwargs.pop("pad_dynamic_dims", False)
+    pad_mask_arg = kwargs.pop("pad_mask_arg", None)
 
     def decorate(fn):
         from paddle_tpu.nn.layer.layers import Layer
@@ -765,12 +834,14 @@ def to_static(function=None, input_spec=None, build_strategy=None,
             sf = StaticFunction(fn.forward, objs=[fn] + list(objs or ()),
                                 donate_states=donate,
                                 input_spec=input_spec,
-                                pad_dynamic_dims=pad_dynamic_dims)
+                                pad_dynamic_dims=pad_dynamic_dims,
+                                pad_mask_arg=pad_mask_arg)
             fn.forward = sf
             return fn
         return StaticFunction(fn, objs=objs, donate_states=donate,
                               input_spec=input_spec,
-                              pad_dynamic_dims=pad_dynamic_dims)
+                              pad_dynamic_dims=pad_dynamic_dims,
+                              pad_mask_arg=pad_mask_arg)
     if function is not None:
         return decorate(function)
     return decorate

@@ -1,0 +1,154 @@
+"""Continuous batching over the dense fixed-capacity cache (round 5).
+
+Reference capability being matched: block_multihead_attention's paged
+KV serving — variable-length multi-request batches with mid-flight
+admission/retirement (/root/reference/python/paddle/incubate/nn/
+functional/block_multihead_attention.py). The TPU design keeps a
+static [slots, capacity] cache; the dynamism is host-side slot
+management over two fixed executables (admit-per-bucket + one decode).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.decode import (ContinuousBatchingSession,
+                                         DecodeSession)
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(7)
+    return LlamaForCausalLM(LlamaConfig.tiny())
+
+
+def _isolated(model, ids, n):
+    return DecodeSession(model, 64).generate(
+        paddle.to_tensor(np.asarray(ids)[None]),
+        max_new_tokens=n).numpy()[0]
+
+
+def test_overlapping_lifetimes_match_isolated_decodes(tiny_model):
+    """Three requests with different prompts/budgets through TWO slots:
+    r2 is admitted mid-flight into the slot r1 frees, while r0 keeps
+    decoding — every per-request output must equal the isolated
+    single-request greedy decode, and the executable count stays at
+    (buckets used, 1)."""
+    m = tiny_model
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(0, 256, (n,)).astype(np.int32)
+               for n in (5, 3, 9)]
+    budgets = [12, 4, 6]
+
+    sess = ContinuousBatchingSession(m, max_slots=2, max_length=64)
+    rids = [sess.submit(p, b) for p, b in zip(prompts, budgets)]
+
+    # with 2 slots, r2 waits in the queue; r1 (budget 4) retires first
+    # and frees its slot while r0 (budget 12) is still decoding
+    completed = []
+    steps = 0
+    while (sess._queue or sess._running) and steps < 64:
+        done = sess.step()
+        completed.extend(done)
+        steps += 1
+        if steps == 1:
+            # after the first step both slots are occupied, r2 queued
+            assert len(sess._running) == 2 and len(sess._queue) == 1
+    out = sess.run()
+
+    # r1 finished before r0 (overlapping lifetimes, not FIFO completion)
+    assert completed.index(rids[1]) < completed.index(rids[0])
+
+    for rid, prompt, budget in zip(rids, prompts, budgets):
+        ref = _isolated(m, prompt, budget)
+        np.testing.assert_array_equal(out[rid], ref,
+                                      err_msg=f"request {rid}")
+
+    n_admit, n_decode = sess.executable_counts()
+    assert n_decode == 1, "decode must stay one executable"
+    assert n_admit <= 3, "admit is bounded by the bucket count"
+
+
+def test_slot_reuse_many_requests_bounded_executables(tiny_model):
+    """Eight short requests through two slots: every slot is reused
+    several times; outputs still match isolated decodes and the
+    executable pool does not grow with request count."""
+    m = tiny_model
+    rng = np.random.RandomState(11)
+    sess = ContinuousBatchingSession(m, max_slots=2, max_length=64)
+    reqs = []
+    for i in range(8):
+        p = rng.randint(0, 256, (rng.randint(2, 12),)).astype(np.int32)
+        b = int(rng.randint(2, 6))
+        reqs.append((sess.submit(p, b), p, b))
+    out = sess.run()
+    for rid, p, b in reqs:
+        np.testing.assert_array_equal(out[rid], _isolated(m, p, b),
+                                      err_msg=f"request {rid}")
+    n_admit, n_decode = sess.executable_counts()
+    assert n_decode == 1 and n_admit <= 4
+
+
+def test_eos_retires_slot_early(tiny_model):
+    """A request whose sampled token hits eos retires before its budget
+    and frees the slot for the queue."""
+    m = tiny_model
+    rng = np.random.RandomState(5)
+    prompt = rng.randint(0, 256, (4,)).astype(np.int32)
+    # find what greedy emits first so we can use it as "eos"
+    first = int(_isolated(m, prompt, 2)[len(prompt)])
+    sess = ContinuousBatchingSession(m, max_slots=1, max_length=64,
+                                     eos_token_id=first)
+    rid = sess.submit(prompt, 10)
+    rid2 = sess.submit(rng.randint(0, 256, (3,)).astype(np.int32), 2)
+    out = sess.run()
+    # retired at the eos token, well under budget
+    assert len(out[rid]) == len(prompt) + 1
+    assert out[rid][-1] == first
+    assert len(out[rid2]) == 3 + 2
+
+
+def test_capacity_guard(tiny_model):
+    sess = ContinuousBatchingSession(tiny_model, max_slots=1,
+                                     max_length=16)
+    with pytest.raises(ValueError, match="capacity"):
+        sess.submit(np.zeros(10, np.int32), 8)
+
+
+def test_sync_every_batched_retirement_same_outputs(tiny_model):
+    """sync_every>1 fetches token blocks instead of per-step tokens;
+    outputs are unchanged (retirement lags, wasted decodes discarded,
+    slot caches reset on admission)."""
+    m = tiny_model
+    rng = np.random.RandomState(21)
+    reqs = [(rng.randint(0, 256, (rng.randint(2, 10),))
+             .astype(np.int32), int(rng.randint(2, 7)))
+            for _ in range(5)]
+    sess = ContinuousBatchingSession(m, max_slots=2, max_length=64,
+                                    sync_every=4)
+    rids = [sess.submit(p, b) for p, b in reqs]
+    out = sess.run()
+    for rid, (p, b) in zip(rids, reqs):
+        np.testing.assert_array_equal(out[rid], _isolated(m, p, b),
+                                      err_msg=f"request {rid}")
+    assert sess.executable_counts()[1] == 1
+
+
+def test_run_delivers_each_request_once(tiny_model):
+    """run() returns only undelivered completions and releases them —
+    a second drain never re-delivers (review finding); explicit
+    request_id collisions are refused."""
+    m = tiny_model
+    rng = np.random.RandomState(31)
+    sess = ContinuousBatchingSession(m, max_slots=1, max_length=64)
+    p1 = rng.randint(0, 256, (4,)).astype(np.int32)
+    rid1 = sess.submit(p1, 3, request_id=5)
+    out1 = sess.run()
+    assert set(out1) == {5}
+    p2 = rng.randint(0, 256, (6,)).astype(np.int32)
+    rid2 = sess.submit(p2, 2)
+    assert rid2 != 5
+    out2 = sess.run()
+    assert set(out2) == {rid2}, "earlier results must not re-deliver"
+    with pytest.raises(ValueError, match="already in use"):
+        sess.submit(p1, 2, request_id=rid2)

@@ -147,3 +147,74 @@ def test_rank_mismatch_raises_clear_error():
     st = paddle.jit.to_static(fwd, input_spec=[InputSpec([None, None])])
     with pytest.raises(ValueError, match="dynamic dim 1"):
         st(paddle.to_tensor(np.zeros((3,), "f4")))
+
+
+def test_pad_mask_bucketed_train_matches_unpadded():
+    """Bucketed dynamic-shape TRAINING (round 5; reference: the PIR
+    shape dialect serves training compilation, 377 ops with
+    InferSymbolicShapeInterface): pad_mask_arg lifts the stateful-objs
+    refusal — the injected mask zero-weights pad positions, so one
+    executable serves a whole bucket of sequence lengths with grads,
+    optimizer state and loss matching the exact unpadded runs. Compile
+    events are counted with jax's own counters (the perf-gate
+    discipline), asserting steady state compiles NOTHING new."""
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from jax._src import test_util as jtu
+
+    def setup():
+        paddle.seed(5)
+        m = LlamaForCausalLM(LlamaConfig.tiny())
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=m.parameters())
+
+        def step(x, y, seq_mask):
+            logits = m(x)                          # [B, S, V] causal
+            v = logits.shape[-1]
+            ce = F.cross_entropy(logits.reshape([-1, v]),
+                                 y.reshape([-1]),
+                                 reduction="none").reshape(x.shape)
+            w = paddle.broadcast_to(seq_mask.unsqueeze(0), x.shape)
+            loss = (ce * w).sum() / w.sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        return m, opt, step
+
+    rng = np.random.RandomState(9)
+    lengths = (44, 57, 62, 51)                     # one 64 bucket
+    batches = [rng.randint(0, 256, (2, s)).astype("int64")
+               for s in lengths]
+
+    # ---- bucketed run ------------------------------------------------
+    m, opt, step = setup()
+    st = paddle.jit.to_static(
+        step, objs=[m, opt],
+        input_spec=[InputSpec([2, None], "int64"),
+                    InputSpec([2, None], "int64")],
+        pad_dynamic_dims=True, pad_mask_arg="seq_mask")
+    losses = []
+    losses.append(float(st(paddle.to_tensor(batches[0]),
+                           paddle.to_tensor(batches[0]))))
+    with jtu.count_jit_compilation_cache_miss() as compiles:
+        for b in batches[1:]:
+            losses.append(float(st(paddle.to_tensor(b),
+                                   paddle.to_tensor(b))))
+    assert compiles() == 0, (
+        f"steady-state bucketed train recompiled {compiles()} times "
+        f"for lengths {lengths[1:]}")
+
+    # ---- exact unpadded oracle --------------------------------------
+    m2, opt2, step2 = setup()
+    for i, b in enumerate(batches):
+        x = paddle.to_tensor(b)
+        mask = paddle.to_tensor(np.ones(b.shape[1], np.float32))
+        ref_loss = float(step2(x, x, mask))
+        np.testing.assert_allclose(losses[i], ref_loss, rtol=2e-4,
+                                   err_msg=f"loss step {i}")
+    for (_, a), (_, c) in zip(m.named_parameters(),
+                              m2.named_parameters()):
+        np.testing.assert_allclose(a.numpy(), c.numpy(), rtol=3e-4,
+                                   atol=3e-5)
