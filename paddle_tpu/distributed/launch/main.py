@@ -44,14 +44,18 @@ def _parse():
                         "ranks/endpoints instead of the original np; "
                         "workers resume from their distributed "
                         "checkpoint at the new world size")
-    p.add_argument("--elastic_store", default=None, metavar="DIR",
-                   help="FileKVStore root watched for scale-OUT join "
+    p.add_argument("--elastic_store", default=None,
+                   metavar="DIR|tcp://HOST:PORT",
+                   help="KV store watched for scale-OUT join "
                         "announcements (the etcd membership dir of the "
                         "reference ElasticManager): a prospective worker "
                         "puts join/<name>; the launcher restarts the job "
                         "at min(MAX, current+joins), and workers resume "
                         "from the distributed checkpoint at the larger "
-                        "world size")
+                        "world size. A plain path selects FileKVStore "
+                        "(shared filesystem); tcp://host:port hosts the "
+                        "native TCPStore in the launcher (no shared fs — "
+                        "the real multi-host deployment shape)")
     p.add_argument("script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p.parse_args()
@@ -123,8 +127,19 @@ def main():
     cur_np = args.nproc_per_node
     store = None
     if args.elastic_store:
-        from paddle_tpu.distributed.elastic import FileKVStore
-        store = FileKVStore(args.elastic_store)
+        if args.elastic_store.startswith("tcp://"):
+            from paddle_tpu.distributed.elastic import TCPKVStore
+            hostport = args.elastic_store[6:]
+            if ":" not in hostport or \
+                    not hostport.rsplit(":", 1)[1].isdigit():
+                raise SystemExit(
+                    f"--elastic_store {args.elastic_store!r}: expected "
+                    "tcp://HOST:PORT with a numeric port")
+            host, port = hostport.rsplit(":", 1)
+            store = TCPKVStore(host, int(port), is_master=True)
+        else:
+            from paddle_tpu.distributed.elastic import FileKVStore
+            store = FileKVStore(args.elastic_store)
     procs = _spawn(args, attempt)
     code = 0
 

@@ -261,23 +261,45 @@ if rank == 0:
                                    atol=1e-6)
 
 
-def test_elastic_scale_out_resumes_from_checkpoint(tmp_path):
+@pytest.mark.parametrize("store_kind", ["file", "tcp"])
+def test_elastic_scale_out_resumes_from_checkpoint(tmp_path,
+                                                   store_kind):
     """End-to-end elastic scale-OUT (the mirror of the scale-in e2e;
     reference ElasticManager manager.py:125 handles both directions):
     2 workers train; a third announces itself through the elastic
     store's join/ prefix; the launcher restarts the job at n=3; workers
     resume from the distributed checkpoint and the final params match
-    an uninterrupted oracle run exactly."""
+    an uninterrupted oracle run exactly.
+
+    store_kind="tcp" (round 5) runs the same e2e over the native
+    TCPStore (store.cc) hosted by the launcher — the no-shared-
+    filesystem multi-host deployment shape — with the joiner
+    announcing itself through a TCPKVStore client."""
     import os
+    import socket as _socket
     import subprocess
     import sys
     import json as _json
 
-    from paddle_tpu.distributed.elastic import FileKVStore
+    from paddle_tpu.distributed.elastic import FileKVStore, TCPKVStore
 
     ck = tmp_path / "ckpt"
     ck.mkdir()
     store_dir = tmp_path / "store"
+    if store_kind == "tcp":
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        tcp_port = s.getsockname()[1]
+        s.close()
+        store_url = f"tcp://127.0.0.1:{tcp_port}"
+
+        def join_client():
+            return TCPKVStore("127.0.0.1", tcp_port, is_master=False)
+    else:
+        store_url = str(store_dir)
+
+        def join_client():
+            return FileKVStore(str(store_dir))
     script = tmp_path / "elastic_out_train.py"
     script.write_text("""
 import json, os, sys, time
@@ -337,7 +359,7 @@ if rank == 0:
     proc = subprocess.Popen(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
          "--nproc_per_node", "2", "--max_restarts", "0",
-         "--np_range", "2:3", "--elastic_store", str(store_dir),
+         "--np_range", "2:3", "--elastic_store", store_url,
          str(script)],
         env=env, stderr=subprocess.PIPE)
     try:
@@ -355,7 +377,7 @@ if rank == 0:
             time.sleep(0.1)
         assert ck_step() >= 2, "attempt 0 never reached step 2"
         # ...then a new worker announces itself
-        FileKVStore(str(store_dir)).put("join/worker-new", "1")
+        join_client().put("join/worker-new", "1")
         _, err = proc.communicate(timeout=180)
     except Exception:
         proc.kill()

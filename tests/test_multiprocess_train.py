@@ -139,3 +139,110 @@ def test_two_process_hybrid_train_matches_single_process(tmp_path,
         np.testing.assert_allclose(got, want, rtol=1e-5, err_msg=(
             f"rank {r}: cross-process hybrid losses {got} != "
             f"single-process oracle {want}"))
+
+
+WORKER_PP4 = r'''
+import os
+
+from paddle_tpu._testing import force_cpu
+force_cpu(2)                       # 2 local devices per process
+import jax
+import numpy as np
+import jax.numpy as jnp
+import paddle_tpu.distributed as dist
+
+dist.init_parallel_env()
+rank = dist.get_rank()
+assert len(jax.devices()) == 8 and len(jax.local_devices()) == 2
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+from paddle_tpu.models.gpt import GPTConfig
+from paddle_tpu.models import gpt_hybrid as GH
+
+cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=8,
+                num_heads=4, max_seq_len=16)
+# dp=1, pp=4, tp=2 over 4 processes x 2 devices: each pipeline STAGE
+# is one process's tp pair, so every 1F1B ppermute hop crosses a
+# process boundary — the DCN-crossing p2p case (reference: multi-node
+# NCCL send/recv between pipeline ranks)
+pcfg = GH.ParallelConfig(dp=1, pp=4, tp=2, sp=True, microbatches=4,
+                         pp_schedule="1f1b", remat=True,
+                         param_dtype=jnp.float32,
+                         compute_dtype=jnp.float32)
+mesh, params, opt_state, step = GH.setup(cfg, pcfg, seed=0,
+                                         devices=jax.devices())
+
+rng = np.random.RandomState(0)
+ids = rng.randint(0, cfg.vocab_size, (4, 16)).astype(np.int32)
+# dp=1: the batch is replicated; every process feeds the full array
+gbatch = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P(None, None)), ids, (4, 16))
+
+with mesh:
+    losses = []
+    for _ in range(2):
+        params, opt_state, loss = step(params, opt_state,
+                                       (gbatch, gbatch))
+        losses.append(float(jax.device_get(
+            loss.addressable_data(0))))
+
+import json, pathlib
+pathlib.Path(os.environ["MARKER_DIR"], f"loss.{rank}").write_text(
+    json.dumps(losses))
+print(f"rank {rank} losses {losses}", flush=True)
+'''
+
+
+def test_four_process_pp_spanning_train_matches_single_process(
+        tmp_path):
+    """Round 5 (VERDICT r4 item 6): 4 processes x 2 devices with the
+    PIPELINE axis spanning every process boundary — each 1F1B
+    collective-permute hop is a cross-process (DCN-class) transfer,
+    the case the 2-process test kept process-local. Loss must match
+    the single-process 8-virtual-device oracle."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.models import gpt_hybrid as GH
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=8,
+                    num_heads=4, max_seq_len=16)
+    pcfg = GH.ParallelConfig(dp=1, pp=4, tp=2, sp=True, microbatches=4,
+                             pp_schedule="1f1b", remat=True,
+                             param_dtype=jnp.float32,
+                             compute_dtype=jnp.float32)
+    mesh, params, opt, step = GH.setup(cfg, pcfg, seed=0,
+                                       devices=jax.devices()[:8])
+    ids = np.random.RandomState(0).randint(0, 128, (4, 16))
+    want = []
+    with mesh:
+        for _ in range(2):
+            params, opt, loss = step(
+                params, opt, (jnp.asarray(ids), jnp.asarray(ids)))
+            want.append(float(loss))
+
+    script = tmp_path / "worker_pp4.py"
+    script.write_text(WORKER_PP4)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo"
+    env["MARKER_DIR"] = str(tmp_path)
+    env.pop("XLA_FLAGS", None)
+    port = _free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "4", "--master", f"127.0.0.1:{port}",
+         str(script)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        start_new_session=True)
+    try:
+        _, stderr = proc.communicate(timeout=900)
+    except subprocess.TimeoutExpired:
+        os.killpg(proc.pid, 9)
+        proc.wait()
+        raise
+    assert proc.returncode == 0, stderr[-1500:]
+    for r in range(4):
+        got = json.loads((tmp_path / f"loss.{r}").read_text())
+        np.testing.assert_allclose(got, want, rtol=1e-5, err_msg=(
+            f"rank {r}: pp-spanning cross-process losses {got} != "
+            f"single-process oracle {want}"))
