@@ -212,6 +212,17 @@ class _Fleet:
         if hcg.get_pipe_parallel_world_size() > 1 and \
                 isinstance(model, PipelineLayer):
             model.build_pipeline(hcg)
+            # the reference wraps pipeline models into PipelineParallel
+            # (fleet/model.py:143) whose train_batch drives the
+            # schedule selected by pipeline_configs["schedule_mode"];
+            # the compiled analog shares the auto-parallel
+            # partitioner's executor (meta_parallel.py). dp>1 needs NO
+            # DataParallel wrapper here: the partitioner shards the
+            # batch over the mesh's dp axis inside the compiled step
+            # (partitioner.py:367) — eager hook-bucketed DP on top
+            # would double the reduction
+            from .meta_parallel import PipelineParallel
+            return PipelineParallel(model, hcg, self._strategy)
         if hcg.get_data_parallel_world_size() > 1:
             model = DataParallel(model, mesh=hcg.process_mesh)
         return model

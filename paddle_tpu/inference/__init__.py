@@ -623,3 +623,14 @@ def load_int8_model(layer, path: str, compute_dtype="float32"):
             b._assign_array(jnp.asarray(data[key]))
     apply_int8_rewrite(layer, compute_dtype)
     return layer
+
+
+def __getattr__(name):
+    # serving sessions live in .decode; export them lazily so importing
+    # paddle_tpu.inference stays light (the decode module pulls model
+    # machinery)
+    if name in ("DecodeSession", "ContinuousBatchingSession"):
+        from . import decode
+        return getattr(decode, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
