@@ -178,11 +178,21 @@ def main():
 
     V5E_PEAK = 1.97e14          # bf16 FLOP/s, one v5e chip
 
+    class _SkipRung(Exception):
+        pass
+
     def _mfu(toks_per_s, fpt):
         return round(toks_per_s * fpt / V5E_PEAK, 4)
 
     rungs = {}
     want_rungs = os.environ.get("BENCH_RUNGS", "all")
+
+    def _want(name):
+        # BENCH_RUNGS: "all" (default), "none", or a comma list of rung
+        # names (train_dataloader_fed,train_s2048,train_s4096,
+        # decode_gpt1.3b_b8)
+        return want_rungs == "all" or name in want_rungs.split(",")
+
     if not on_cpu and want_rungs != "none":
         import gc
 
@@ -223,6 +233,8 @@ def main():
         # batch — proves the loader does not throttle the step
         # (VERDICT r4 item 8). Reuses the primary rung's compiled step.
         try:
+            if not _want("train_dataloader_fed"):
+                raise _SkipRung()
             import paddle_tpu as paddle
 
             class _Synth(paddle.io.Dataset):
@@ -261,6 +273,8 @@ def main():
             rungs["train_dataloader_fed"] = {
                 "tokens_per_sec": round(dl_tps, 1),
                 "vs_pinned_batch": round(dl_tps / tokens_per_sec, 4)}
+        except _SkipRung:
+            pass
         except Exception as e:  # noqa: BLE001
             rungs["train_dataloader_fed"] = {
                 "error": f"{type(e).__name__}: {e}"}
@@ -280,6 +294,8 @@ def main():
         # causal-skip attention kernel's VMEM-adaptive dispatch
         for name, s_, b_ in (("train_s2048", 2048, 4),
                              ("train_s4096", 4096, 2)):
+            if not _want(name):
+                continue
             try:
                 c = GPTConfig(vocab_size=50304, hidden_size=1024,
                               num_layers=24, num_heads=8,
@@ -293,6 +309,8 @@ def main():
         # path, B8, bf16 weights) — the exact round-4 on-chip
         # configuration (benchmarks/_decode_bench.py), recorded
         try:
+            if not _want("decode_gpt1.3b_b8"):
+                raise _SkipRung()
             import paddle_tpu as paddle
             from paddle_tpu.inference.decode import DecodeSession
             from paddle_tpu.models.gpt import GPTForCausalLM
@@ -313,6 +331,8 @@ def main():
             rungs["decode_gpt1.3b_b8"] = {
                 "tokens_per_sec": round(8 * 64 / d_dt, 1)}
             del ds, gm
+        except _SkipRung:
+            pass
         except Exception as e:  # noqa: BLE001
             rungs["decode_gpt1.3b_b8"] = {
                 "error": f"{type(e).__name__}: {e}"}

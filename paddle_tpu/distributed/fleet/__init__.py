@@ -221,8 +221,22 @@ class _Fleet:
             # batch over the mesh's dp axis inside the compiled step
             # (partitioner.py:367) — eager hook-bucketed DP on top
             # would double the reduction
-            from .meta_parallel import PipelineParallel
-            return PipelineParallel(model, hcg, self._strategy)
+            from .meta_parallel import (PipelineParallel,
+                                        UnpartitionableModel)
+            try:
+                return PipelineParallel(model, hcg, self._strategy)
+            except (UnpartitionableModel, NotImplementedError) as e:
+                # heterogeneous chains / sep-sharding hybrids keep the
+                # old pass-through behavior (forward works; train_batch
+                # needs a partitionable chain) instead of hard-failing
+                # at wrap time
+                import warnings
+                warnings.warn(
+                    f"fleet PipelineParallel unavailable for this "
+                    f"model ({e}); returning the bare pipeline layer "
+                    "(forward/eval works; use the auto-parallel Engine "
+                    "or the hybrid engine for pipelined training)",
+                    stacklevel=2)
         if hcg.get_data_parallel_world_size() > 1:
             model = DataParallel(model, mesh=hcg.process_mesh)
         return model

@@ -27,6 +27,13 @@ import numpy as np
 from paddle_tpu.nn.layer.layers import Layer
 
 
+class UnpartitionableModel(ValueError):
+    """The model's structure cannot take the compiled pipeline executor
+    (no homogeneous block run / unsupported hybrid axes) — a STRUCTURAL
+    limitation distributed_model treats as pass-through, unlike config
+    errors (bad schedule_mode), which must surface."""
+
+
 class PipelineParallel(Layer):
     """Wrap a PipelineLayer for fleet-driven pipeline training."""
 
@@ -66,6 +73,7 @@ class PipelineParallel(Layer):
         # batch must divide accumulate_steps (the partitioner's
         # microbatching contract).
         micro = max(1, int(cfg.get("accumulate_steps", 1)))
+        self._micro_bs = cfg.get("micro_batch_size")
 
         # the PipelineLayer desc chain mixes prologue/epilogue entries
         # (embedding lambdas, the head) with the homogeneous block run;
@@ -77,15 +85,21 @@ class PipelineParallel(Layer):
         if not blocks:
             blocks = find_pipeline_blocks(layers)
         if not blocks:
-            raise ValueError(
+            raise UnpartitionableModel(
                 "PipelineParallel needs a homogeneous block run in its "
                 "layer chain (the reference PipelineLayer contract); "
                 "none found on this model")
         dp = hcg.get_data_parallel_world_size()
         mp = hcg.get_model_parallel_world_size()
         n = dp * pp * mp
-        devs = np.asarray(jax.devices()[:n]).reshape(dp, pp, mp)
-        mesh = Mesh(devs, ("dp", "pp", "mp"))
+        # keep the hcg topology's device layout (pp outermost, then
+        # mp, then dp — topology._ORDER with the size-1 sep/sharding
+        # axes squeezed): stage s of the compiled mesh must be the
+        # same devices hcg.get_pipe_parallel_group() reports, or
+        # reference-style code keyed on stage identity disagrees with
+        # where the program actually placed the stages
+        devs = np.asarray(jax.devices()[:n]).reshape(pp, mp, dp)
+        mesh = Mesh(devs.transpose(2, 0, 1), ("dp", "pp", "mp"))
         self._layers = layers
         self._partition = PipelinePartition(
             layers, getattr(layers, "_loss_fn", None), blocks, mesh,
@@ -97,18 +111,18 @@ class PipelineParallel(Layer):
 
     @staticmethod
     def _longest_homogeneous_run(children):
-        def sig(c):
-            return tuple((n, tuple(p.shape))
-                         for n, p in c.named_parameters())
+        sigs = [tuple((n, tuple(p.shape))
+                      for n, p in c.named_parameters())
+                for c in children]
         best, cur = [], []
-        for c in children:
-            if cur and sig(c) == sig(cur[-1]) and sig(c):
-                cur.append(c)
+        for c, s in zip(children, sigs):
+            if cur and s and s == cur[-1][1]:
+                cur.append((c, s))
             else:
-                cur = [c]
+                cur = [(c, s)]
             if len(cur) > len(best):
                 best = list(cur)
-        return best if len(best) >= 2 else None
+        return [c for c, _ in best] if len(best) >= 2 else None
 
     # transparent layer facade -----------------------------------------
     def forward(self, *args, **kwargs):
@@ -129,6 +143,20 @@ class PipelineParallel(Layer):
             raise NotImplementedError(
                 "train_batch with a GradScaler: use amp.auto_cast "
                 "inside the loss or the hybrid engine's AMP path")
+        x0 = data[0]
+        bs = x0.shape[0]
+        if self._micro_bs and \
+                bs != self._partition.microbatches * int(self._micro_bs) \
+                and not getattr(self, "_mb_warned", False):
+            import warnings
+            warnings.warn(
+                f"pipeline_configs: batch {bs} != accumulate_steps "
+                f"({self._partition.microbatches}) * micro_batch_size "
+                f"({self._micro_bs}); the batch is split into "
+                f"accumulate_steps microbatches of {bs // self._partition.microbatches} "
+                "— micro_batch_size is informational here",
+                stacklevel=2)
+            self._mb_warned = True
         if self._step is None or self._opt is not optimizer:
             import paddle_tpu as paddle
 

@@ -106,3 +106,55 @@ def test_schedule_mode_guards():
     model = _make_pipeline_layer()
     with pytest.raises(ValueError, match="schedule_mode"):
         fleet.distributed_model(model)
+
+
+def test_heterogeneous_chain_passes_through_with_warning():
+    """Structural incapability (no homogeneous block run) keeps the old
+    pass-through behavior — forward works, a warning names the limit —
+    while config errors (bad schedule_mode) still raise."""
+    import warnings as _w
+    _init_fleet("1F1B")
+    paddle.seed(0)
+    descs = [LayerDesc(nn.Linear, 8, 12), LayerDesc(nn.Linear, 12, 6),
+             LayerDesc(nn.Linear, 6, 4)]
+    het = PipelineLayer(descs, num_stages=2,
+                        loss_fn=nn.CrossEntropyLoss())
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        out = fleet.distributed_model(het)
+    assert any("PipelineParallel unavailable" in str(r.message)
+               for r in rec), [str(r.message) for r in rec]
+    y = out(paddle.randn([4, 8]))
+    assert y.shape == [4, 4]
+
+
+def test_dp2_pp2_hybrid_layout_matches_oracle():
+    """dp2 x pp2 through the fleet facade: the batch shards over the
+    compiled mesh's dp axis (no eager DataParallel wrapper) and the
+    hcg-consistent pp-outer device layout trains to the same losses as
+    the single-device oracle."""
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 2, "sharding_degree": 1}
+    strategy.pipeline = True
+    strategy.pipeline_configs = {"accumulate_steps": 2,
+                                 "schedule_mode": "1F1B"}
+    fleet.init(is_collective=True, strategy=strategy)
+    model = fleet.distributed_model(_make_pipeline_layer())
+    opt = paddle.optimizer.SGD(0.05, parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 8).astype("f4"))
+    y = paddle.to_tensor(rng.randint(0, 4, (8,)))
+    losses = [float(model.train_batch((x, y), opt)) for _ in range(3)]
+
+    oracle = _make_pipeline_layer()
+    opt0 = paddle.optimizer.SGD(0.05, parameters=oracle.parameters())
+    ce = nn.CrossEntropyLoss()
+    want = []
+    for _ in range(3):
+        loss = ce(oracle(x), y)
+        loss.backward()
+        opt0.step()
+        opt0.clear_grad()
+        want.append(float(loss))
+    np.testing.assert_allclose(losses, want, rtol=1e-4)
