@@ -39,8 +39,10 @@ reqs = [(rng.randint(0, 32000, (int(rng.randint(pmax // 4, pmax)),))
 total_new = sum(b for _, b in reqs)
 
 SYNC = int(os.environ.get("CB_SYNC", "8"))
+BLK = int(os.environ.get("CB_BLOCK", "0")) or None
 sess = ContinuousBatchingSession(model, max_slots=SLOTS,
-                                 max_length=CAP, sync_every=SYNC)
+                                 max_length=CAP, sync_every=SYNC,
+                                 decode_block=BLK)
 for ids, budget in reqs[:SLOTS]:
     sess.submit(ids, budget)
 # warm both executables
@@ -55,7 +57,7 @@ done_new = sum(len(v) - len(reqs[i][0]) for i, v in out.items())
 print(f"continuous batching: {done_new} tokens in {dt:.2f}s = "
       f"{done_new / dt:.1f} tok/s "
       f"(slots={SLOTS}, cap={CAP}, {NREQ} requests, "
-      f"sync_every={SYNC})")
+      f"sync_every={SYNC}, block={BLK})")
 print(f"executables: admit={sess.executable_counts()[0]} "
       f"decode={sess.executable_counts()[1]}")
 

@@ -233,10 +233,11 @@ class _Fleet:
                 import warnings
                 warnings.warn(
                     f"fleet PipelineParallel unavailable for this "
-                    f"model ({e}); returning the bare pipeline layer "
-                    "(forward/eval works; use the auto-parallel Engine "
-                    "or the hybrid engine for pipelined training)",
-                    stacklevel=2)
+                    f"model ({e}); falling back to the plain wrap path "
+                    "(the pipeline layer as-is, DataParallel-wrapped "
+                    "when dp_degree > 1 — forward/eval works; use the "
+                    "auto-parallel Engine or the hybrid engine for "
+                    "pipelined training)", stacklevel=2)
         if hcg.get_data_parallel_world_size() > 1:
             model = DataParallel(model, mesh=hcg.process_mesh)
         return model

@@ -517,7 +517,7 @@ class ContinuousBatchingSession:
     def __init__(self, model, max_slots, max_length,
                  prefill_buckets=None, temperature=0.0, top_p=None,
                  top_k=None, eos_token_id=None, seed=0,
-                 sync_every=1):
+                 sync_every=1, decode_block=None):
         model.eval()
         self._model = model
         self._slots = int(max_slots)
@@ -568,6 +568,19 @@ class ContinuousBatchingSession:
         # reference's block-scheduler makes with its step quantum.
         self._sync_every = max(1, int(sync_every))
         self._pending: List = []
+        # decode_block=k runs k decode steps per DISPATCH in one
+        # lax.while_loop program (the DecodeSession block-decode idea
+        # applied to the slot batch): one dispatch emits a [slots, k]
+        # token block, amortizing the per-step dispatch cost.
+        # sync_every counts DISPATCHES in either mode, so block mode
+        # drains every sync_every blocks (retirement lag up to
+        # k*sync_every - 1 steps, same discard semantics); the usual
+        # block config is sync_every=1 + decode_block=k.
+        self._decode_block = int(decode_block) if decode_block else None
+        if self._decode_block:
+            self._decode_blk_jit = jax.jit(
+                self._decode_block_pure,
+                donate_argnums=tuple(range(n + 3, n + 3 + nc)))
 
     # ---------------- compiled programs ------------------------------
     def _slot_slice(self, cache_arrays, slot):
@@ -611,28 +624,58 @@ class ContinuousBatchingSession:
                                           slot, plen)
         return tokens, key, cache_arrays
 
-    def _decode_pure(self, *flat):
-        n = len(self._state_t)
-        state = flat[:n]
-        tokens, key, active = flat[n:n + 3]
-        cache_arrays = flat[n + 3:]
+    def _masked_step(self, state, tok, key, active, cache_arrays):
+        """ONE masked decode step — the single home of the per-slot
+        semantics shared by the per-step and block programs: inactive
+        slots pass their token through and keep their cache length
+        pinned (their valid region must not move while they wait for
+        the next admission; the k/v rows the masked step wrote there
+        are dead — the next admit's prefill overwrites the slot from
+        position 0)."""
         logits, cache_out = _bind_and_run(
-            self._model, self._state_t, state, tokens[:, None],
-            self._cache_treedef, cache_arrays)
+            self._model, self._state_t, state, tok[:, None],
+            self._cache_treedef, list(cache_arrays))
         nxt, key = _sample(logits[:, -1], key, self._temperature,
                            self._top_p, self._top_k)
-        nxt = jnp.where(active, nxt, tokens)
-        # pin retired/empty slots' lengths: their cache valid region
-        # must not move while they wait for the next admission (the
-        # k/v rows the masked step wrote there are dead — the next
-        # admit's prefill overwrites the slot from position 0)
+        nxt = jnp.where(active, nxt, tok)
         old = jax.tree_util.tree_unflatten(self._cache_treedef,
-                                           cache_arrays)
+                                           list(cache_arrays))
         new = jax.tree_util.tree_unflatten(self._cache_treedef,
                                            cache_out)
         fixed = [(k, v, jnp.where(active, ln, lo))
                  for (k, v, ln), (_k, _v, lo) in zip(new, old)]
         return nxt, key, jax.tree_util.tree_leaves(fixed)
+
+    def _decode_block_pure(self, *flat):
+        """`decode_block` batched decode steps in ONE program: a
+        while_loop over _masked_step carrying (tokens, key, out,
+        caches)."""
+        n = len(self._state_t)
+        state = flat[:n]
+        tokens, key, active = flat[n:n + 3]
+        cache_arrays = tuple(flat[n + 3:])
+        blk = self._decode_block
+        out0 = jnp.zeros((self._slots, blk), jnp.int32)
+
+        def body(carry):
+            i, tok, key, out, caches = carry
+            nxt, key, fixed = self._masked_step(state, tok, key,
+                                                active, caches)
+            out = out.at[:, i].set(nxt)
+            return (i + 1, nxt, key, out, tuple(fixed))
+
+        carry = (jnp.int32(0), tokens, key, out0, cache_arrays)
+        _i, tokens, key, out, cache_arrays = lax.while_loop(
+            lambda c: c[0] < blk, body, carry)
+        return out, tokens, key, list(cache_arrays)
+
+    def _decode_pure(self, *flat):
+        n = len(self._state_t)
+        state = flat[:n]
+        tokens, key, active = flat[n:n + 3]
+        cache_arrays = flat[n + 3:]
+        return self._masked_step(state, tokens, key, active,
+                                 cache_arrays)
 
     # ---------------- host-side slot management ----------------------
     def submit(self, input_ids, max_new_tokens, request_id=None):
@@ -699,14 +742,20 @@ class ContinuousBatchingSession:
             return
         entries = self._pending
         self._pending = []
-        rows = np.asarray(jax.device_get(
-            jnp.stack([t for (_k, _s, t) in entries])))
-        for (kind, aslot, _t), row in zip(entries, rows):
+        fetched = jax.device_get([t for (_k, _s, t) in entries])
+        for (kind, aslot, _t), row in zip(entries, fetched):
+            row = np.asarray(row)
             if kind == "admit":
                 req = self._running.get(aslot)
                 if req is not None:
                     req.tokens.append(int(row[aslot]))
                     self._maybe_retire(req)
+                continue
+            if kind == "block":
+                for col in range(row.shape[1]):
+                    for slot, req in list(self._running.items()):
+                        req.tokens.append(int(row[slot, col]))
+                        self._maybe_retire(req)
                 continue
             for slot, req in list(self._running.items()):
                 req.tokens.append(int(row[slot]))
@@ -724,11 +773,19 @@ class ContinuousBatchingSession:
             state = [t._data for t in self._state_t]
             active = np.zeros((self._slots,), bool)
             active[list(self._running)] = True
-            self._tokens, self._key, self._cache_arrays = \
-                self._decode_jit(*state, self._tokens, self._key,
-                                 jnp.asarray(active),
-                                 *self._cache_arrays)
-            self._pending.append(("step", None, self._tokens))
+            if self._decode_block:
+                blk_out, self._tokens, self._key, self._cache_arrays = \
+                    self._decode_blk_jit(*state, self._tokens,
+                                         self._key,
+                                         jnp.asarray(active),
+                                         *self._cache_arrays)
+                self._pending.append(("block", None, blk_out))
+            else:
+                self._tokens, self._key, self._cache_arrays = \
+                    self._decode_jit(*state, self._tokens, self._key,
+                                     jnp.asarray(active),
+                                     *self._cache_arrays)
+                self._pending.append(("step", None, self._tokens))
         if len(self._pending) >= self._sync_every:
             self._drain_pending()
         return [r for r in self._done if r not in before]
@@ -751,9 +808,12 @@ class ContinuousBatchingSession:
     def executable_counts(self):
         """(n_admit_executables, n_decode_executables): admit is
         bounded by the bucket count, decode must stay 1 however many
-        requests flow through."""
-        return (self._admit_jit._cache_size(),
-                self._decode_jit._cache_size())
+        requests flow through (in block mode the block program is THE
+        decode executable)."""
+        n_dec = self._decode_jit._cache_size()
+        if self._decode_block:
+            n_dec += self._decode_blk_jit._cache_size()
+        return (self._admit_jit._cache_size(), n_dec)
 
 
 def cached_generate(model, input_ids, max_new_tokens=16, temperature=0.0,

@@ -152,3 +152,22 @@ def test_run_delivers_each_request_once(tiny_model):
     assert set(out2) == {rid2}, "earlier results must not re-deliver"
     with pytest.raises(ValueError, match="already in use"):
         sess.submit(p1, 2, request_id=rid2)
+
+
+def test_decode_block_mode_same_outputs(tiny_model):
+    """decode_block=k emits [slots, k] token blocks per dispatch (one
+    while_loop program — the DecodeSession block decoder over the slot
+    batch); outputs are unchanged and the executable count stays 1."""
+    m = tiny_model
+    rng = np.random.RandomState(41)
+    reqs = [(rng.randint(0, 256, (rng.randint(2, 10),))
+             .astype(np.int32), int(rng.randint(2, 9)))
+            for _ in range(5)]
+    sess = ContinuousBatchingSession(m, max_slots=2, max_length=64,
+                                     decode_block=4)
+    rids = [sess.submit(p, b) for p, b in reqs]
+    out = sess.run()
+    for rid, (p, b) in zip(rids, reqs):
+        np.testing.assert_array_equal(out[rid], _isolated(m, p, b),
+                                      err_msg=f"request {rid}")
+    assert sess.executable_counts()[1] == 1
