@@ -305,6 +305,57 @@ def main():
                 rungs[name] = {"error": f"{type(e).__name__}: {e}"}
             _cleanup()
 
+        # serving rung: continuous batching with block decode — the
+        # round-5 serving capability (overlapping request lifetimes
+        # over the dense slot cache; one while_loop block program per
+        # dispatch). Aggregate generated tok/s over a 16-request burst.
+        try:
+            if not _want("serve_cb_block16"):
+                raise _SkipRung()
+            import paddle_tpu as paddle
+            from paddle_tpu.inference.decode import \
+                ContinuousBatchingSession
+            from paddle_tpu.models.llama import (LlamaConfig,
+                                                 LlamaForCausalLM)
+            paddle.seed(0)
+            lcm = LlamaForCausalLM(LlamaConfig(
+                vocab_size=32000, hidden_size=2048,
+                intermediate_size=5504, num_layers=24, num_heads=16,
+                num_kv_heads=16, max_seq_len=512))
+            lcm.bfloat16()
+            cbs = ContinuousBatchingSession(
+                lcm, max_slots=8, max_length=512, decode_block=16)
+            cb_rng = np.random.RandomState(0)
+            cb_reqs = [(cb_rng.randint(0, 32000, (
+                int(cb_rng.randint(32, 128)),)).astype(np.int32),
+                int(cb_rng.randint(64, 128))) for _ in range(16)]
+            for pr, bu in cb_reqs[:8]:
+                cbs.submit(pr, bu)
+            cbs.step()                                    # warm
+            for pr, bu in cb_reqs[8:]:
+                cbs.submit(pr, bu)
+            # tokens emitted by the warm dispatch land before t0 —
+            # exclude them from the timed count
+            warm = {r.rid: len(r.tokens)
+                    for r in list(cbs._running.values())
+                    + list(cbs._done.values())}
+            t0 = time.perf_counter()
+            cb_out = cbs.run()
+            cb_dt = time.perf_counter() - t0
+            done_new = sum(
+                len(v) - len(cb_reqs[i][0]) - warm.get(i, 0)
+                for i, v in cb_out.items())
+            rungs["serve_cb_block16"] = {
+                "tokens_per_sec": round(done_new / cb_dt, 1),
+                "requests": 16, "slots": 8}
+            del cbs, lcm
+        except _SkipRung:
+            pass
+        except Exception as e:  # noqa: BLE001
+            rungs["serve_cb_block16"] = {
+                "error": f"{type(e).__name__}: {e}"}
+        _cleanup()
+
         # decode rung: GPT-1.3B serving throughput (per-step decode
         # path, B8, bf16 weights) — the exact round-4 on-chip
         # configuration (benchmarks/_decode_bench.py), recorded
