@@ -227,6 +227,43 @@ def leg_d():
     return f"OK sum={float(r.sum()):.0f}"
 
 
+def leg_f():
+    """lax.all_to_all over a manual axis inside a pp-divergent cond —
+    feasibility probe for zero-bubble x EP-MoE (the GShard dispatch).
+    Expected to behave like the subgroup collectives (legs A/D), NOT
+    like ppermute (leg E): all_to_all lowers with subgroup
+    replica_groups, so tp-group-uniform predicates rendezvous."""
+    def body(x):
+        s = lax.axis_index("pp")
+
+        def tick(c, t):
+            def active():
+                return _v(("pp", "tp"),
+                          lax.all_to_all(c.reshape(2, H // 2, H),
+                                         "tp", split_axis=0,
+                                         concat_axis=1, tiled=False)
+                          .reshape(H, H))
+
+            def idle():
+                return _v(("pp", "tp"), jnp.zeros((H, H), c.dtype))
+
+            y = lax.cond(s == 0, active, idle)  # divergent over pp
+            y = lax.ppermute(y, "pp",
+                             [(i, (i + 1) % 2) for i in range(2)])
+            return y, None
+
+        out, _ = lax.scan(tick, _v(("pp", "tp"), x), jnp.arange(2))
+        return lax.psum(out, ("pp", "tp")) / 4
+
+    x = jnp.ones((H, H), jnp.float32)
+    fn = jax.jit(shard_map(
+        body, mesh=mesh, axis_names={"pp", "tp"},
+        in_specs=(P(),), out_specs=P()))
+    r = fn(x)
+    r.block_until_ready()
+    return f"OK sum={float(r.sum()):.0f}"
+
+
 def leg_e():
     """ppermute over tp inside a pp-DIVERGENT cond: expected DEADLOCK
     (whole-mesh collective-permute lowering; see module docstring)."""
@@ -264,6 +301,7 @@ if __name__ == "__main__":
                       ("B gspmd-auto-in-cond", leg_b),
                       ("C psum-hoisted", leg_c),
                       ("D sp-gather-scatter-in-cond", leg_d),
+                      ("F all_to_all-in-divergent-cond", leg_f),
                       ("E ppermute-in-divergent-cond", leg_e)]:
         try:
             r = _with_alarm(leg, 60)

@@ -759,11 +759,14 @@ def _validate_pp_schedule(pcfg):
             f"pp_schedule={pcfg.pp_schedule!r} does not compose with "
             "expert-parallel MoE: the zero-bubble phases are cond-gated "
             "per pipeline stage and the GSPMD-inserted EP all-to-all "
-            "inside a cond branch deadlocks the mesh (and the manual-tp "
-            "stage body has no MoE form). tp>1 DOES compose since round "
-            "5 — the stage body switches to the manual-tp formulation "
-            "with explicit in-branch collectives "
-            "(models/gpt_manual_tp.py). Use '1f1b' for EP hybrids.")
+            "inside a cond branch deadlocks the mesh. tp>1 DOES compose "
+            "since round 5 — the stage body switches to the manual-tp "
+            "formulation with explicit in-branch collectives "
+            "(models/gpt_manual_tp.py); an EXPLICIT manual-axis "
+            "all_to_all is likewise legal in-branch (probe leg F in "
+            "benchmarks/_r5_cond_collective_probe.py), so zb x MoE "
+            "needs only a manual-ep MoE stage body — unimplemented. "
+            "Use '1f1b' for EP hybrids.")
     if pcfg.pp_schedule == "zbvpp" and pcfg.pp <= 1:
         raise ValueError("pp_schedule='zbvpp' requires pp > 1 (the "
                          "V placement spans a pipeline ring)")
