@@ -52,9 +52,10 @@ class ParallelConfig:
     # analog of the reference 1F1B, pipeline_parallel.py:547);
     # "zbh1"/"zbvpp": zero-bubble schedules with cond-gated phases and
     # dx/dW-split backward (reference pipeline_zero_bubble.py:62/:151).
-    # tp>1 composes via the manual-tp stage body with explicit
-    # in-branch collectives (models/gpt_manual_tp.py, round 5);
-    # EP-MoE does not (no manual form for the all-to-all).
+    # tp>1 composes via the manual-tp stage body, EP-MoE via the
+    # manual-ep body (explicit in-branch collectives,
+    # models/gpt_manual_tp.py, round 5); only tp>1 AND MoE combined
+    # is refused (no combined manual body).
     # "zbvpp" runs TWO model chunks per device in the V placement
     # (layers split 2*pp ways; num_layers % (2*pp) == 0)
     pp_schedule: str = "gpipe"
@@ -644,6 +645,15 @@ def _train_grads_1f1b(params, batch, cfg, pcfg, mesh):
     from paddle_tpu.parallel.pipeline import pipeline_microbatch
     from paddle_tpu.parallel.pipeline_1f1b import pipeline_train_1f1b
 
+    if pcfg.pp_schedule in ("zbh1", "zbvpp") and pcfg.tp == 1 \
+            and pcfg.num_experts > 0 and pcfg.dp > 1:
+        # zero-bubble x EP-MoE: the manual-ep stage body (explicit
+        # all-to-all over the manual dp axis — in-branch legal, probe
+        # leg F in benchmarks/_r5_cond_collective_probe.py)
+        from paddle_tpu.models.gpt_manual_tp import \
+            train_grads_zb_manual_ep
+        return train_grads_zb_manual_ep(params, batch, cfg, pcfg, mesh)
+
     use_manual_tp = pcfg.tp > 1 and pcfg.num_experts == 0 and (
         pcfg.pp_schedule in ("zbh1", "zbvpp")
         or (pcfg.pp_schedule == "1f1b" and pcfg.vpp_chunks == 1
@@ -754,19 +764,18 @@ def _validate_pp_schedule(pcfg):
             "(the interleaved schedule generalizes the compiled 1F1B; "
             "'zbvpp' brings its own two V-placed chunks)")
     if pcfg.pp_schedule in ("zbh1", "zbvpp") and pcfg.num_experts > 0 \
-            and (pcfg.dp > 1 or pcfg.tp > 1):
+            and pcfg.tp > 1:
         raise ValueError(
-            f"pp_schedule={pcfg.pp_schedule!r} does not compose with "
-            "expert-parallel MoE: the zero-bubble phases are cond-gated "
-            "per pipeline stage and the GSPMD-inserted EP all-to-all "
-            "inside a cond branch deadlocks the mesh. tp>1 DOES compose "
-            "since round 5 — the stage body switches to the manual-tp "
-            "formulation with explicit in-branch collectives "
-            "(models/gpt_manual_tp.py); an EXPLICIT manual-axis "
-            "all_to_all is likewise legal in-branch (probe leg F in "
-            "benchmarks/_r5_cond_collective_probe.py), so zb x MoE "
-            "needs only a manual-ep MoE stage body — unimplemented. "
-            "Use '1f1b' for EP hybrids.")
+            f"pp_schedule={pcfg.pp_schedule!r} with BOTH tp>1 and "
+            "expert-parallel MoE: the manual stage bodies exist per "
+            "axis (manual-tp, manual-ep — models/gpt_manual_tp.py) but "
+            "not combined. Use tp=1 for zb x MoE, or '1f1b' for the "
+            "full tp x ep hybrid.")
+    if pcfg.pp_schedule in ("zbh1", "zbvpp") and pcfg.num_experts > 0 \
+            and pcfg.dp > 1 and pcfg.num_experts % pcfg.dp:
+        raise ValueError(
+            f"zb x MoE shards experts over dp: num_experts "
+            f"{pcfg.num_experts} must divide by dp {pcfg.dp}")
     if pcfg.pp_schedule == "zbvpp" and pcfg.pp <= 1:
         raise ValueError("pp_schedule='zbvpp' requires pp > 1 (the "
                          "V placement spans a pipeline ring)")

@@ -132,24 +132,47 @@ def block_manual_tp(x, lp, cfg: GPTConfig, pcfg, tp_axis="tp"):
     return hres + ff
 
 
+def _remat_wrap(fn, pcfg):
+    """The engine's remat-policy dispatch (gpt_hybrid._stack_apply),
+    shared by every manual stage stack. The policies replay the
+    explicit collectives in backward — in-branch recompute collectives
+    are covered by the same uniform-predicate argument as forward."""
+    if not pcfg.remat:
+        return fn
+    if pcfg.remat_policy == "dots":
+        return jax.checkpoint(
+            fn, policy=jax.checkpoint_policies.dots_saveable)
+    if pcfg.remat_policy == "names":
+        return jax.checkpoint(
+            fn, policy=jax.checkpoint_policies
+            .save_only_these_names(*pcfg.remat_save_names))
+    return jax.checkpoint(fn)
+
+
+def _require_sequential_cpu_scheduler(what):
+    """Fail fast with a diagnosis instead of a 40s rendezvous-timeout
+    crash: XLA:CPU's concurrency-optimized thunk scheduler issues
+    data-independent manual collectives in divergent per-device orders
+    and deadlocks (round-5 finding; TPU executes one uniform program
+    order and is unaffected)."""
+    import os
+    if jax.default_backend() == "cpu" and \
+            "xla_cpu_enable_concurrency_optimized_scheduler=false" not \
+            in os.environ.get("XLA_FLAGS", ""):
+        raise RuntimeError(
+            f"{what} on the XLA:CPU backend requires XLA_FLAGS to "
+            "include --xla_cpu_enable_concurrency_optimized_scheduler"
+            "=false (set before jax initializes); the concurrency-"
+            "optimized thunk scheduler deadlocks the manual "
+            "collectives' rendezvous")
+
+
 def stack_apply_manual_tp(blocks, x, cfg, pcfg, tp_axis="tp"):
-    """lax.scan over the local layer stack (manual-tp `_stack_apply`).
-    The remat policies replay the explicit collectives in backward —
-    in-branch recompute collectives are covered by the same tp-uniform-
-    predicate argument as the forward ones."""
+    """lax.scan over the local layer stack (manual-tp `_stack_apply`)."""
     def body(h, lp):
-        fn = functools.partial(block_manual_tp, cfg=cfg, pcfg=pcfg,
-                               tp_axis=tp_axis)
-        if pcfg.remat:
-            if pcfg.remat_policy == "dots":
-                fn = jax.checkpoint(
-                    fn, policy=jax.checkpoint_policies.dots_saveable)
-            elif pcfg.remat_policy == "names":
-                fn = jax.checkpoint(
-                    fn, policy=jax.checkpoint_policies
-                    .save_only_these_names(*pcfg.remat_save_names))
-            else:
-                fn = jax.checkpoint(fn)
+        fn = _remat_wrap(
+            functools.partial(block_manual_tp, cfg=cfg, pcfg=pcfg,
+                              tp_axis=tp_axis), pcfg)
         return fn(h, lp), None
     out, _ = lax.scan(body, x, blocks, unroll=max(1, pcfg.scan_unroll))
     return out
@@ -211,8 +234,19 @@ def ce_vocab_parallel(h, wte_local, labels, tp_axis="tp",
 def _manual_blk_flat_specs(moe: bool):
     """Per-layer (no stacking dims) manual partition entries for the
     reshaped block tree; the leading stacking dims ('pp' + chunk/layer)
-    are prepended per-leaf by rank in `_manual_blk_specs`."""
-    assert not moe, "manual-tp zero-bubble stage has no MoE body"
+    are prepended per-leaf by rank in `_manual_blk_specs`. moe=True is
+    the manual-EP layout (tp=1): expert dims shard over 'dp', dense
+    weights replicate."""
+    if moe:
+        return {
+            "ln1_g": (None,), "ln1_b": (None,),
+            "qkv_w": (None, None, None), "qkv_b": (None, None),
+            "proj_w": (None, None), "proj_b": (None,),
+            "ln2_g": (None,), "ln2_b": (None,),
+            "gate_w": (None, None),
+            "fc1_w": ("dp", None, None), "fc1_b": ("dp", None),
+            "fc2_w": ("dp", None, None), "fc2_b": ("dp", None),
+        }
     return {
         "ln1_g": (None,), "ln1_b": (None,),
         "qkv_w": (None, None, "tp"), "qkv_b": (None, "tp"),
@@ -282,27 +316,9 @@ def train_grads_zb_manual_tp(params, batch, cfg: GPTConfig, pcfg, mesh):
         raise ValueError(
             f"manual-tp stage needs num_heads {cfg.num_heads} % tp "
             f"{pcfg.tp} == 0 (heads are the column-parallel unit)")
-    import os
-    if jax.default_backend() == "cpu" and \
-            "xla_cpu_enable_concurrency_optimized_scheduler=false" not \
-            in os.environ.get("XLA_FLAGS", ""):
-        # fail fast with a diagnosis instead of a 40s rendezvous-
-        # timeout crash: XLA:CPU's concurrency-optimized thunk
-        # scheduler issues data-independent manual collectives in
-        # divergent per-device orders and deadlocks (round-5 finding;
-        # TPU executes one uniform program order and is unaffected).
-        # Applies to every manual-tp pipeline route — the cond-gated
-        # zero-bubble schedules AND the lockstep ring-collective-matmul
-        # 1F1B (whose many data-independent ring steps race the same
-        # way).
-        raise RuntimeError(
-            "manual-tp pipeline stage bodies (zero-bubble with tp>1, "
-            "or 1F1B with collective_matmul at pp>1) on the XLA:CPU "
-            "backend require XLA_FLAGS to include "
-            "--xla_cpu_enable_concurrency_optimized_scheduler=false "
-            "(set before jax initializes); the concurrency-optimized "
-            "thunk scheduler deadlocks the manual collectives' "
-            "rendezvous")
+    _require_sequential_cpu_scheduler(
+        "manual-tp pipeline stage bodies (zero-bubble with tp>1, or "
+        "1F1B with collective_matmul at pp>1)")
     if pcfg.fused_ce:
         # the manual head is the (unfused) vocab-parallel CE: the
         # fused chunked LM-head+CE kernel assumes a replicated wte and
@@ -391,6 +407,195 @@ def train_grads_zb_manual_tp(params, batch, cfg: GPTConfig, pcfg, mesh):
     return loss, {
         "wte": dwte_e.astype(jnp.float32)
         + (hgrads["wte"] if vpad == 0 else hgrads["wte"][:V]),
+        "wpe": dwpe.astype(jnp.float32),
+        "blocks": bgrads,
+        "lnf_g": hgrads["lnf_g"],
+        "lnf_b": hgrads["lnf_b"],
+    }
+
+
+# ------------------- manual-ep MoE stage (zb x MoE) -------------------
+
+def moe_ffn_manual_ep(x, lp, num_experts, ep_axis="dp"):
+    """GShard switch-MoE with an EXPLICIT all-to-all over the manual
+    `ep_axis` (EP=DP) — the in-branch-legal form of gpt_hybrid._moe_ffn
+    (probe leg F: all_to_all lowers with subgroup replica_groups, so a
+    divergent pipeline predicate cannot strand it, unlike ppermute).
+
+    Local shapes: x [bl, s, h] (this member's batch rows);
+    fc1_w [E_local, h, m], fc2_w [E_local, m, h] (experts sharded over
+    ep_axis); gate_w [h, E] replicated. Dense dispatch: every member
+    routes its tokens to all E experts, the all-to-all exchanges the
+    expert dim for the token dim, local experts compute, and the
+    reverse all-to-all brings the rows home."""
+    bl, s, h = x.shape
+    e = num_experts
+    tokens = x.reshape(bl * s, h)
+    gate_logits = tokens.astype(jnp.float32) @ \
+        lp["gate_w"].astype(jnp.float32)
+    probs = jax.nn.softmax(gate_logits, -1)
+    top = jnp.argmax(probs, -1)
+    gate = jnp.max(probs, -1).astype(x.dtype)
+    disp = jax.nn.one_hot(top, e, dtype=x.dtype)           # [Tl, E]
+    xin = jnp.einsum("te,th->eth", disp, tokens)           # [E, Tl, h]
+    # exchange: expert shards out, token shards in ->
+    # [E_local, Tl * ep, h]
+    xin = lax.all_to_all(xin, ep_axis, split_axis=0, concat_axis=1,
+                         tiled=True)
+    hmid = jax.nn.gelu(
+        jnp.einsum("eth,ehm->etm", xin, lp["fc1_w"])
+        + lp["fc1_b"][:, None, :])
+    hout = jnp.einsum("etm,emh->eth", hmid, lp["fc2_w"]) \
+        + lp["fc2_b"][:, None, :]
+    # reverse exchange: token shards out, expert shards in -> [E, Tl, h]
+    hout = lax.all_to_all(hout, ep_axis, split_axis=1, concat_axis=0,
+                          tiled=True)
+    combined = jnp.einsum("te,eth->th", disp, hout) * gate[:, None]
+    return combined.reshape(bl, s, h)
+
+
+def block_manual_ep(x, lp, cfg: GPTConfig, pcfg, ep_axis="dp"):
+    """Transformer block for the zb x MoE stage: attention is local
+    per batch row (tp=1 — _validate_pp_schedule rejects tp>1 with
+    MoE), the FFN is the manual-ep MoE."""
+    from jax.ad_checkpoint import checkpoint_name
+    from paddle_tpu.models.gpt_hybrid import _attend
+    hres = x
+    hx = _ln(x, lp["ln1_g"], lp["ln1_b"])
+    qkv = checkpoint_name(
+        jnp.einsum("bsh,hkj->bskj", hx, lp["qkv_w"])
+        + lp["qkv_b"], "qkv")
+    attn = checkpoint_name(
+        _attend(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+                cfg.num_heads), "attn_out")
+    attn = checkpoint_name(attn @ lp["proj_w"] + lp["proj_b"], "proj")
+    x = hres + attn
+    hres = x
+    hx = _ln(x, lp["ln2_g"], lp["ln2_b"])
+    ff = checkpoint_name(
+        moe_ffn_manual_ep(hx, lp, pcfg.num_experts, ep_axis), "ffn2")
+    return hres + ff
+
+
+def stack_apply_manual_ep(blocks, x, cfg, pcfg, ep_axis="dp"):
+    def body(h, lp):
+        fn = _remat_wrap(
+            functools.partial(block_manual_ep, cfg=cfg, pcfg=pcfg,
+                              ep_axis=ep_axis), pcfg)
+        return fn(h, lp), None
+    out, _ = lax.scan(body, x, blocks, unroll=max(1, pcfg.scan_unroll))
+    return out
+
+
+def train_grads_zb_manual_ep(params, batch, cfg: GPTConfig, pcfg,
+                             mesh):
+    """Zero-bubble pipelines with an EP-MoE stage body: shard_map
+    manual over {'pp','dp'} — the batch shards over dp, expert weights
+    shard their E dim over dp, the GShard all-to-all is explicit (and
+    in-branch legal), and the dp grad reduction for replicated params
+    falls out of AD's pvary transpose psums (the same mechanism that
+    makes the manual-tp body work). tp must be 1."""
+    from paddle_tpu.parallel.pipeline import pipeline_microbatch
+    from paddle_tpu.parallel.pipeline_1f1b import (
+        pipeline_train_zbh1, pipeline_train_zbvpp)
+    from paddle_tpu.models.gpt_hybrid import _constrain
+
+    assert pcfg.tp == 1 and pcfg.num_experts > 0 and pcfg.dp > 1
+    if pcfg.num_experts % pcfg.dp:
+        raise ValueError(
+            f"manual-ep stage needs num_experts {pcfg.num_experts} % "
+            f"dp {pcfg.dp} == 0 (experts shard over the dp axis)")
+    _require_sequential_cpu_scheduler("zero-bubble x MoE")
+    if pcfg.fused_ce or pcfg.sp:
+        import warnings
+        warnings.warn(
+            "the manual-ep zero-bubble route supports neither fused_ce "
+            "(the head materializes [tokens, vocab] logits per "
+            "microbatch) nor sp — both are ignored on this route",
+            stacklevel=3)
+
+    input_ids, labels = batch
+    cdt = pcfg.compute_dtype
+    b, s = input_ids.shape
+    m = pcfg.microbatches
+    if b % m or (b // m) % pcfg.dp:
+        raise ValueError(
+            f"manual-ep needs batch {b} divisible by microbatches {m} "
+            f"and each microbatch's {b // m if b % m == 0 else '?'} "
+            f"rows divisible by dp {pcfg.dp} (the batch shards over "
+            "the manual dp axis)")
+
+    def embed(wte, wpe):
+        return wte[input_ids].astype(cdt) + wpe[:s][None].astype(cdt)
+
+    x, embed_vjp = jax.vjp(embed, params["wte"], params["wpe"])
+    x = _constrain(x, P("dp", None, None), mesh)
+    mb = pipeline_microbatch(x, m)                 # [m, b/m, s, h]
+    lbl_mb = pipeline_microbatch(labels, m)
+    blocks = jax.tree_util.tree_map(lambda p: p.astype(cdt),
+                                    params["blocks"])
+    blocks = _reshape_qkv(blocks)
+    head_params = {"wte": params["wte"], "lnf_g": params["lnf_g"],
+                   "lnf_b": params["lnf_b"]}
+
+    def stage_fn(stage_params, xm):
+        return stack_apply_manual_ep(stage_params, xm, cfg, pcfg)
+
+    def body(blocks, mb, lbl_mb, head_params):
+        ndp = lax.axis_size("dp")
+
+        def last_grad(y, hp, mb_idx):
+            lbl = lbl_mb[mb_idx]
+
+            def head_loss(hp_, y_):
+                hh = _ln(y_, hp_["lnf_g"].astype(cdt),
+                         hp_["lnf_b"].astype(cdt))
+                # local-rows CE scaled by 1/dp: the global loss is the
+                # mean over dp members' local means, so each member's
+                # cotangents (restricted to its rows) carry the 1/dp
+                logits = jnp.einsum(
+                    "bsh,vh->bsv", hh,
+                    hp_["wte"].astype(hh.dtype))[:, :-1]
+                logits = logits.astype(jnp.float32)
+                tgt = lbl[:, 1:]
+                logz = jax.scipy.special.logsumexp(logits, axis=-1)
+                picked = jnp.take_along_axis(
+                    logits, tgt[..., None], axis=-1)[..., 0]
+                return jnp.mean(logz - picked) / (m * ndp)
+
+            (l, (ghp, gy)) = jax.value_and_grad(
+                head_loss, argnums=(0, 1))(hp, y)
+            return l, gy, ghp
+
+        if pcfg.pp_schedule == "zbvpp":
+            loss, bgrads, hgrads, dx0 = pipeline_train_zbvpp(
+                stage_fn, blocks, mb, last_grad,
+                head_params=head_params, serialize_phases=True)
+        else:
+            loss, bgrads, hgrads, dx0 = pipeline_train_zbh1(
+                stage_fn, blocks, mb, last_grad,
+                head_params=head_params, serialize_phases=True)
+        # rank-0 dp-varying values cannot ride out_specs — emit the
+        # per-member partial losses as a [1] vector (P('dp') -> [dp])
+        return loss[None], bgrads, hgrads, dx0
+
+    blk_specs = _manual_blk_specs(blocks, moe=True)
+    mb_spec = P(None, "dp", None, None)
+    hp_specs = {"wte": P(), "lnf_g": P(), "lnf_b": P()}
+    loss, bgrads, hgrads, dx0 = jax.shard_map(
+        body, mesh=mesh, axis_names={"pp", "dp"},
+        in_specs=(blk_specs, mb_spec, P(None, "dp", None), hp_specs),
+        out_specs=(P("dp"), blk_specs, hp_specs,
+                   P(None, "dp", None, None)))(
+            blocks, mb, lbl_mb, head_params)
+
+    # the per-member losses are partial (1/dp-scaled local means):
+    # their sum is the global loss
+    loss = jnp.sum(loss)
+    bgrads = _unreshape_qkv_grads(bgrads, params["blocks"])
+    dwte_e, dwpe = embed_vjp(dx0.reshape(b, s, -1).astype(x.dtype))
+    return loss, {
+        "wte": dwte_e.astype(jnp.float32) + hgrads["wte"],
         "wpe": dwpe.astype(jnp.float32),
         "blocks": bgrads,
         "lnf_g": hgrads["lnf_g"],

@@ -197,11 +197,15 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params, x_microbatches,
         lambda p: _zeros_matching_vma(p, dtype=grad_dtype,
                                       extra=(axis_name,)), my_params)
     # structure probe (unused outputs are DCE'd by XLA)
-    _, _, probe_hg = last_stage_grad(_za(), head_params_v,
-                                     jnp.zeros((), jnp.int32))
+    probe_l, _, probe_hg = last_stage_grad(_za(), head_params_v,
+                                           jnp.zeros((), jnp.int32))
     head0 = None if probe_hg is None else jax.tree_util.tree_map(
         lambda g: _zeros_matching_vma(g, dtype=grad_dtype,
                                       extra=(axis_name,)), probe_hg)
+    # the loss carry matches the head's own vma (a manual-ep head
+    # returns per-member partial losses, dp-varying)
+    loss0 = _zeros_matching_vma(probe_l, shape=(), dtype=grad_dtype,
+                                extra=(axis_name,))
     dx0_buf0 = _za((m,) + x_shape)
 
     def tick(carry, t):
@@ -251,8 +255,7 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params, x_microbatches,
         return (act_out, cot_out, stash, grads, head, loss,
                 dx0_buf), None
 
-    carry0 = (act0, cot0, stash0, grads0, head0,
-              _varying(jnp.zeros((), grad_dtype)), dx0_buf0)
+    carry0 = (act0, cot0, stash0, grads0, head0, loss0, dx0_buf0)
     carry, _ = lax.scan(tick, carry0, jnp.arange(t_total))
     _, _, _, grads, head, loss, dx0_buf = carry
     return _pipeline_epilogue(axis_name, s, n, loss, head, dx0_buf,
@@ -622,11 +625,15 @@ def pipeline_train_zbh1(stage_fn: Callable, stage_params, x_microbatches,
     grads0 = jax.tree_util.tree_map(
         lambda p: _zeros_matching_vma(p, dtype=grad_dtype,
                                       extra=(axis_name,)), my_params)
-    _, _, probe_hg = last_stage_grad(_za(), head_params_v,
-                                     jnp.zeros((), jnp.int32))
+    probe_l, _, probe_hg = last_stage_grad(_za(), head_params_v,
+                                           jnp.zeros((), jnp.int32))
     head0 = None if probe_hg is None else jax.tree_util.tree_map(
         lambda g: _zeros_matching_vma(g, dtype=grad_dtype,
                                       extra=(axis_name,)), probe_hg)
+    # the loss carry matches the head's own vma (a manual-ep head
+    # returns per-member partial losses, dp-varying)
+    loss0 = _zeros_matching_vma(probe_l, shape=(), dtype=grad_dtype,
+                                extra=(axis_name,))
     dx0_buf0 = _za((m,) + x_shape)
 
     def w_phase(nW, grads, wstash_x, wstash_gy, fire):
@@ -723,7 +730,7 @@ def pipeline_train_zbh1(stage_fn: Callable, stage_params, x_microbatches,
 
     carry0 = (act0, cot0, stash0, wstash_x0, wstash_gy0,
               _v(jnp.zeros((), jnp.int32)), grads0, head0,
-              _v(jnp.zeros((), grad_dtype)), dx0_buf0)
+              loss0, dx0_buf0)
     carry, _ = lax.scan(tick, carry0, jnp.arange(t_total))
     (_, _, _, wstash_x, wstash_gy, nW, grads, head, loss,
      dx0_buf) = carry
@@ -889,11 +896,13 @@ def pipeline_train_zbvpp(stage_fn: Callable, stage_params,
     grads0 = jax.tree_util.tree_map(
         lambda p: _zeros_matching_vma(p, dtype=grad_dtype,
                                       extra=(axis_name,)), lane_params)
-    _, _, probe_hg = last_stage_grad(_za(), head_params_v,
-                                     jnp.zeros((), jnp.int32))
+    probe_l, _, probe_hg = last_stage_grad(_za(), head_params_v,
+                                           jnp.zeros((), jnp.int32))
     head0 = None if probe_hg is None else jax.tree_util.tree_map(
         lambda g: _zeros_matching_vma(g, dtype=grad_dtype,
                                       extra=(axis_name,)), probe_hg)
+    loss0 = _zeros_matching_vma(probe_l, shape=(), dtype=grad_dtype,
+                                extra=(axis_name,))
 
     def w_phase(lane_p, wk, nW, lane_grads, wx, wgy, fire):
         """Retire ONE deferred weight-grad of one lane when `fire`.
@@ -1047,7 +1056,7 @@ def pipeline_train_zbvpp(stage_fn: Callable, stage_params,
               _v(jnp.zeros((), jnp.int32)),
               _v(jnp.zeros((), jnp.int32)),
               grads0,
-              head0, _v(jnp.zeros((), grad_dtype)),
+              head0, loss0,
               _za((m,) + x_shape))
     carry, _ = lax.scan(tick, carry0, jnp.arange(t_total))
     (_, _, _, _, _, _, _, _, wx0, wgy0, wx1, wgy1, nW0, nW1,

@@ -136,19 +136,22 @@ def test_engine_zbh1_loss_parity():
 
 
 def test_zbh1_schedule_composition_guards():
-    """Since round 5, tp>1 composes with the zero-bubble schedules (the
-    manual-tp stage body, models/gpt_manual_tp.py); expert-parallel MoE
-    still does not — the EP all-to-all has no manual in-branch form —
-    and must be refused with a diagnosis."""
+    """Since round 5, tp>1 AND ep-MoE each compose with the
+    zero-bubble schedules (manual-tp / manual-ep stage bodies,
+    models/gpt_manual_tp.py); only their COMBINATION is refused."""
     from paddle_tpu.models.gpt import GPTConfig
     from paddle_tpu.models import gpt_hybrid as GH
     cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=4,
                     num_heads=2, max_seq_len=16)
-    # EP-MoE over dp: still rejected under zero-bubble
-    pcfg_moe = GH.ParallelConfig(dp=2, pp=2, tp=1, microbatches=2,
+    # tp>1 AND EP-MoE together: rejected (no combined manual body)
+    pcfg_moe = GH.ParallelConfig(dp=2, pp=2, tp=2, microbatches=2,
                                  num_experts=2, pp_schedule="zbh1")
     with pytest.raises(ValueError, match="MoE"):
         GH.build_train_step(cfg, pcfg_moe, None)
+    # each alone: accepted
+    GH._validate_pp_schedule(GH.ParallelConfig(
+        dp=2, pp=2, tp=1, microbatches=2, num_experts=2,
+        pp_schedule="zbh1"))
     # tp>1: accepted — validation passes (full parity is covered by
     # tests/test_pipeline_zb_tp.py)
     pcfg_tp = GH.ParallelConfig(dp=1, pp=2, tp=2, microbatches=2,

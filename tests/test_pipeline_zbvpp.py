@@ -192,14 +192,17 @@ def test_zbvpp_rejects_collective_stage_bodies_and_bad_layers():
     from paddle_tpu.models import gpt_hybrid as GH
     cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=4,
                     num_heads=2, max_seq_len=16)
-    # tp>1 composes since round 5 (manual-tp stage body); EP-MoE
-    # remains refused — no manual in-branch form for the all-to-all
-    pcfg = GH.ParallelConfig(dp=2, pp=2, tp=1, microbatches=2,
+    # tp>1 AND ep-MoE each compose since round 5 (manual-tp /
+    # manual-ep stage bodies); only their COMBINATION is refused
+    pcfg = GH.ParallelConfig(dp=2, pp=2, tp=2, microbatches=2,
                              num_experts=2, pp_schedule="zbvpp")
     with pytest.raises(ValueError, match="MoE"):
         GH.build_train_step(cfg, pcfg, None)
     GH._validate_pp_schedule(GH.ParallelConfig(
         dp=1, pp=2, tp=2, microbatches=2, pp_schedule="zbvpp"))
+    GH._validate_pp_schedule(GH.ParallelConfig(
+        dp=2, pp=2, tp=1, microbatches=2, num_experts=2,
+        pp_schedule="zbvpp"))
     # pp=1 has no ring for the V placement
     with pytest.raises(ValueError, match="pp > 1"):
         GH.build_train_step(
