@@ -168,21 +168,20 @@ def main():
     # long-context + decode + input-pipeline capabilities, not just the
     # flagship config; VERDICT r4 weak #2). Each rung is best-effort —
     # a failure records an error string instead of killing the bench.
-    def _gpt_flops_per_token(c, s_):
-        n = (c.vocab_size * c.hidden_size
-             + c.max_seq_len * c.hidden_size
-             + c.num_layers * (12 * c.hidden_size * c.hidden_size
-                               + 13 * c.hidden_size)
-             + 2 * c.hidden_size)
-        return 6 * n + 12 * c.num_layers * c.hidden_size * s_
+    # single home of the flops/MFU math: cost_model (shared with the
+    # observability MFU gauge)
+    from paddle_tpu.cost_model import TPU_SPECS as _SPECS
+    from paddle_tpu.cost_model import gpt_flops_per_token as \
+        _gpt_flops_per_token
+    from paddle_tpu.cost_model import mfu as _cm_mfu
 
-    V5E_PEAK = 1.97e14          # bf16 FLOP/s, one v5e chip
+    V5E_PEAK = _SPECS["v5e"]["flops"]   # bf16 FLOP/s, one v5e chip
 
     class _SkipRung(Exception):
         pass
 
     def _mfu(toks_per_s, fpt):
-        return round(toks_per_s * fpt / V5E_PEAK, 4)
+        return round(_cm_mfu(toks_per_s, fpt, "v5e"), 4)
 
     rungs = {}
     want_rungs = os.environ.get("BENCH_RUNGS", "all")

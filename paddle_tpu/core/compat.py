@@ -1,0 +1,43 @@
+"""jax version compatibility seams.
+
+The manual-axes parallel stack (compiled pipelines, manual-tp,
+collective matmuls) is written against the modern jax surface:
+top-level ``jax.shard_map`` plus the varying-manual-axes type system
+(``lax.pcast`` / ``jax.typeof(...).vma``). Older jax (< 0.6) only has
+``jax.experimental.shard_map`` and no vma tracking at all — there is
+no faithful emulation of pcast there, so this module does NOT try:
+
+* ``shard_map``: the real function wherever it lives. On old jax the
+  experimental one is re-signatured to accept/ignore ``check_vma``
+  (mapped onto ``check_rep=False`` — without vma types replication
+  checking rejects the pipeline bodies).
+* ``HAS_MANUAL_AXES``: capability flag — True when the vma type system
+  (``lax.pcast``) exists, i.e. when the compiled-pipeline /manual-tp
+  paths can actually trace. Callers (and tests) gate on this instead
+  of crashing mid-trace with an AttributeError.
+"""
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+#: the varying-manual-axes type system the compiled pipelines need
+HAS_MANUAL_AXES: bool = hasattr(lax, "pcast")
+
+try:
+    from jax import shard_map  # modern jax: top-level function
+except ImportError:            # pragma: no cover - depends on jax build
+    from jax.experimental.shard_map import shard_map as _esm
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None, **kw):
+        """Old-jax fallback: experimental shard_map, check_vma→check_rep
+        (False: no vma types to check against), axis_names→auto (the
+        complement set, experimental's way of leaving axes automatic)."""
+        kw.pop("check_vma", None)
+        kw.setdefault("check_rep", False)
+        names = kw.pop("axis_names", None)
+        if names is not None and mesh is not None:
+            kw.setdefault("auto",
+                          frozenset(mesh.axis_names) - frozenset(names))
+        return _esm(f, mesh=mesh, in_specs=in_specs,
+                    out_specs=out_specs, **kw)

@@ -21,6 +21,12 @@ import jax.numpy as jnp
 from .tensor import Tensor
 from .flags import get_flag
 from paddle_tpu.autograd.tape import Edge, GradNode
+from paddle_tpu.observability import metrics as _met
+
+# eager-dispatch telemetry (observability layer): one counter, cached at
+# import — the hot path pays a single `_met._ENABLED` branch when off
+_op_dispatches = _met.REGISTRY.counter("eager.op_dispatches")
+_op_grad_recorded = _met.REGISTRY.counter("eager.grad_ops")
 
 # --- global eager state (reference: egr::Controller / imperative::Tracer) ---
 _grad_enabled = True
@@ -96,6 +102,8 @@ def run_op(name: str, fn: Callable, *inputs, n_outputs=None, amp=True,
     a tuple of arrays. Tensor inputs are unwrapped; non-Tensor inputs are
     converted with jnp.asarray.
     """
+    if _met._ENABLED:
+        _op_dispatches.inc()
     arrays = []
     in_tensors = []
     for x in inputs:
@@ -145,6 +153,8 @@ def run_op(name: str, fn: Callable, *inputs, n_outputs=None, amp=True,
     out_tensors = [Tensor._wrap(a, stop_gradient=sg) for a in outs]
 
     if record:
+        if _met._ENABLED:
+            _op_grad_recorded.inc()
         edges = []
         for t, need in zip(in_tensors, needs):
             if not need:

@@ -8,6 +8,8 @@ from (a) an analytic roofline over published TPU peak numbers
 hardware, and (b) `profile_measure`, which times a jitted callable on
 the attached device — the measured path the reference gets from its
 benchmark table."""
-from .cost_model import CostModel, TPU_SPECS, OpCost  # noqa: F401
+from .cost_model import (  # noqa: F401
+    CostModel, TPU_SPECS, OpCost, gpt_flops_per_token, mfu)
 
-__all__ = ["CostModel", "TPU_SPECS", "OpCost"]
+__all__ = ["CostModel", "TPU_SPECS", "OpCost", "gpt_flops_per_token",
+           "mfu"]

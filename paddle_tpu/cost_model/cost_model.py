@@ -19,6 +19,25 @@ TPU_SPECS: Dict[str, Dict[str, float]] = {
 }
 
 
+def gpt_flops_per_token(cfg, seq_len: int) -> float:
+    """Training FLOPs per token of a GPT-family config: 6*N for the
+    parameter matmuls (fwd + bwd) + the 12*L*H*S attention term — the
+    single home of the formula bench.py and the observability MFU gauge
+    share. `cfg` needs vocab_size/hidden_size/max_seq_len/num_layers."""
+    n = (cfg.vocab_size * cfg.hidden_size
+         + cfg.max_seq_len * cfg.hidden_size
+         + cfg.num_layers * (12 * cfg.hidden_size * cfg.hidden_size
+                             + 13 * cfg.hidden_size)
+         + 2 * cfg.hidden_size)
+    return float(6 * n + 12 * cfg.num_layers * cfg.hidden_size * seq_len)
+
+
+def mfu(tokens_per_s: float, flops_per_token: float,
+        chip: str = "v5e") -> float:
+    """Achieved model-flops utilization against one chip's bf16 peak."""
+    return tokens_per_s * flops_per_token / TPU_SPECS[chip]["flops"]
+
+
 @dataclass
 class OpCost:
     """Cost estimate for one op (reference: auto_parallel cost items:

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.observability import metrics as _met
 from .env import Group, get_world_size
 
 
@@ -152,7 +153,16 @@ class defer_collectives:
         return False
 
 
-def _collective_log(op, tensor, group):
+def _collective_log(op, tensor, group, n_tensors=1):
+    if _met._ENABLED:
+        _met.REGISTRY.counter("collective.calls", op=op).inc()
+        try:
+            a = tensor._data if isinstance(tensor, Tensor) else tensor
+            nbytes = (int(np.prod(a.shape))
+                      * np.dtype(a.dtype).itemsize * n_tensors)
+            _met.REGISTRY.counter("collective.bytes", op=op).inc(nbytes)
+        except Exception:
+            pass           # object collectives / None payloads
     from paddle_tpu.core.flags import get_flag
     if get_flag("FLAGS_collective_debug"):
         import sys
@@ -225,11 +235,13 @@ def broadcast_object_list(object_list, src=0, group=None):
 
 
 def reduce(tensor: Tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    _collective_log("reduce", tensor, group)
     return _Task(tensor)
 
 
 def scatter(tensor: Tensor, tensor_list=None, src=0, group=None,
             sync_op=True):
+    _collective_log("scatter", tensor, group)
     if tensor_list:
         tensor._assign_array(tensor_list[0]._data)
     return _Task(tensor)
@@ -251,7 +263,7 @@ def reduce_scatter(tensor: Tensor, tensor_list, op=ReduceOp.SUM, group=None,
 
 def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
     _collective_log("alltoall", in_tensor_list[0] if in_tensor_list
-                    else None, group)
+                    else None, group, n_tensors=len(in_tensor_list))
     out_tensor_list.clear()
     out_tensor_list.extend([Tensor._wrap(t._data) for t in in_tensor_list])
     return _Task(None)
@@ -259,6 +271,7 @@ def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
 
 def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
+    _collective_log("alltoall_single", in_tensor, group)
     if out_tensor is not None:
         out_tensor._assign_array(in_tensor._data)
         return _Task(out_tensor)
@@ -266,10 +279,12 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
 
 
 def send(tensor: Tensor, dst=0, group=None, sync_op=True):
+    _collective_log("send", tensor, group)
     return _Task(tensor)
 
 
 def recv(tensor: Tensor, src=0, group=None, sync_op=True):
+    _collective_log("recv", tensor, group)
     return _Task(tensor)
 
 
@@ -305,6 +320,7 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     """Single-controller semantics like scatter/all_gather above: every
     rank's shard is this process's tensor (reference
     communication/gather.py)."""
+    _collective_log("gather", tensor, group)
     n = _world(group)
     if gather_list is not None:
         gather_list.clear()

@@ -22,9 +22,13 @@ schedule_mode mapping (reference names, case-insensitive):
 """
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from paddle_tpu.nn.layer.layers import Layer
+from paddle_tpu.observability import metrics as _met
+from paddle_tpu.observability import training as _otrain
 
 
 class UnpartitionableModel(ValueError):
@@ -59,13 +63,28 @@ class PipelineParallel(Layer):
         mode = str(cfg.get("schedule_mode", "1F1B")).lower()
         sched = {"1f1b": "1f1b", "zbh1": "zbh1",
                  "zbvpp": "zbvpp", "zbv": "zbvpp"}.get(mode)
+        self._sched_error = None
         if sched is None:
-            raise ValueError(
+            # unsupported schedule_mode is a TRAIN-path config error:
+            # raising here would also kill forward/eval-only flows that
+            # never call train_batch, so the wrap keeps working as a
+            # plain facade and train_batch() raises (reference configs
+            # routinely carry FThenB/VPP/Eager1F1B strings that only
+            # matter once train_batch runs)
+            self._sched_error = (
                 f"pipeline_configs schedule_mode {mode!r}: supported "
                 "modes are 1F1B, ZBH1, ZBVPP/ZBV (FThenB's compiled "
                 "analog is the GPipe rotation — parallel/pipeline.py — "
                 "kept off this facade because 1F1B strictly bounds its "
                 "memory)")
+            self._layers = layers
+            self._partition = None
+            self._mesh = None
+            self._sched = None
+            self._step = None
+            self._opt = None
+            self._micro_bs = cfg.get("micro_batch_size")
+            return
         # accumulate_steps maps 1:1 onto pipeline microbatches (the
         # reference feeds accumulate_steps micro-batches per
         # train_batch); the default 1 runs a single microbatch — a deep
@@ -139,6 +158,8 @@ class PipelineParallel(Layer):
         compiled pipeline, applies the optimizer, steps the scheduler.
         The whole step is one jitted program (compiled on first call,
         reused after)."""
+        if self._sched_error is not None:
+            raise ValueError(self._sched_error)
         if scaler is not None:
             raise NotImplementedError(
                 "train_batch with a GradScaler: use amp.auto_cast "
@@ -172,8 +193,26 @@ class PipelineParallel(Layer):
                 _step, objs=[self._layers, optimizer])
             self._opt = optimizer
         x, y = data
+        t0 = time.perf_counter()
         with self._mesh:
             loss = self._step(x, y)
+        if _met._ENABLED:
+            # close the timing window on the step's completion, not its
+            # async dispatch (a dispatch-only window reports impossible
+            # tokens/s on a real accelerator); metrics-off runs keep
+            # full dispatch pipelining
+            try:
+                import jax
+                jax.block_until_ready(loss._data)
+            except Exception:
+                pass
+            tokens = None
+            arr = getattr(x0, "_data", None)
+            if arr is not None and arr.ndim >= 2 and \
+                    np.issubdtype(np.dtype(arr.dtype), np.integer):
+                tokens = int(arr.shape[0]) * int(arr.shape[1])
+            _otrain.record_step(time.perf_counter() - t0,
+                                samples=int(bs), tokens=tokens)
         if lr_scheduler is not None:
             lr_scheduler.step()
         return loss

@@ -12,6 +12,8 @@ import paddle_tpu as paddle
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.io import DataLoader
 from paddle_tpu.metric import Metric
+from paddle_tpu.observability import metrics as _met
+from paddle_tpu.observability import training as _otrain
 
 
 class Callback:
@@ -270,6 +272,9 @@ class Model:
     # --------------------------------------------------------------- steps
     def train_batch(self, inputs, labels=None):
         self.network.train()
+        # unconditional: enabling metrics mid-step must not record a
+        # dt measured from 0.0 (perf_counter is ~ns, no cost to skip)
+        t0 = time.perf_counter()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         labels = labels if labels is None or isinstance(
             labels, (list, tuple)) else [labels]
@@ -280,12 +285,33 @@ class Model:
         loss.backward()
         self._optimizer.step()
         self._optimizer.clear_grad()
+        loss_val = float(loss)
+        if _met._ENABLED:
+            # timed AFTER the float(loss) device sync: the step's true
+            # end — timing only the async dispatch would report
+            # impossible throughput on a real accelerator
+            self._record_step_metrics(time.perf_counter() - t0, inputs)
         metrics = []
         for m in self._metrics:
             m.update(m.compute(outputs, *labels)
                      if labels is not None else m.compute(outputs))
             metrics.append(m.accumulate())
-        return ([float(loss)], metrics) if metrics else [float(loss)]
+        return ([loss_val], metrics) if metrics else [loss_val]
+
+    @staticmethod
+    def _record_step_metrics(dt, inputs):
+        """One train step into the observability registry: step time,
+        samples/s, and — for token batches ([B, S] integer ids) —
+        tokens/s feeding the MFU gauge when
+        observability.training.configure() declared the model cost."""
+        samples = tokens = None
+        x = inputs[0] if inputs else None
+        if isinstance(x, Tensor) and x.ndim >= 1:
+            samples = int(x.shape[0])
+            import numpy as _np
+            if x.ndim >= 2 and _np.issubdtype(x._data.dtype, _np.integer):
+                tokens = int(x.shape[0]) * int(x.shape[1])
+        _otrain.record_step(dt, samples=samples, tokens=tokens)
 
     @paddle.no_grad()
     def eval_batch(self, inputs, labels=None):

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import collections
 import math
+import time
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -38,6 +39,7 @@ from jax import lax
 
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.core.dispatch import run_op
+from paddle_tpu.observability import metrics as _met
 
 # Per-layer fixed-capacity cache. k/v: [B, C, num_kv_heads, head_dim];
 # length: [B] int32 — number of valid positions per sequence.
@@ -386,6 +388,7 @@ class DecodeSession:
         of the batch (per-sequence finished state); the loop exits early
         once every sequence has finished (checked every 8 steps so the
         device pipeline is not serialized by per-token host syncs)."""
+        t0 = time.perf_counter()
         ids = input_ids._data if isinstance(input_ids, Tensor) else \
             jnp.asarray(input_ids)
         ids = ids.astype(jnp.int32)
@@ -421,6 +424,9 @@ class DecodeSession:
             gen = self._generate_blocks(state, token, key, finished,
                                         cache_arrays, b,
                                         max_new_tokens - 1)
+            if _met._ENABLED:
+                jax.block_until_ready(gen)
+            self._record_generate(t0, b, s, int(gen.shape[1]))
             return Tensor._wrap(jnp.concatenate([ids, gen], axis=1),
                                 True)
 
@@ -438,7 +444,26 @@ class DecodeSession:
                     jax.device_get(jnp.all(finished))):
                 break
         gen = jnp.stack(outs, axis=1)
+        if _met._ENABLED:
+            # close the timing window on completion, not dispatch —
+            # async futures would report impossible tokens/s
+            jax.block_until_ready(gen)
+        self._record_generate(t0, b, s, len(outs))
         return Tensor._wrap(jnp.concatenate([ids, gen], axis=1), True)
+
+    @staticmethod
+    def _record_generate(t0, batch, prompt_len, n_new):
+        if not _met._ENABLED:
+            return
+        dt = time.perf_counter() - t0
+        r = _met.REGISTRY
+        r.counter("serving.generate_calls").inc()
+        r.counter("serving.prefill_tokens").inc(batch * prompt_len)
+        r.counter("serving.decode_tokens").inc(batch * n_new)
+        r.histogram("serving.generate_latency_s").observe(dt)
+        if dt > 0:
+            r.gauge("serving.decode_tokens_per_s").set(
+                batch * n_new / dt)
 
     def _generate_blocks(self, state, token, key, finished, cache_arrays,
                          b, m_total):
@@ -476,13 +501,15 @@ class DecodeSession:
 
 
 class _Request:
-    __slots__ = ("rid", "ids", "plen", "budget", "tokens", "slot")
+    __slots__ = ("rid", "ids", "plen", "budget", "tokens", "slot",
+                 "t_submit")
 
     def __init__(self, rid, ids, plen, budget):
         self.rid, self.ids, self.plen = rid, ids, plen
         self.budget = budget
         self.tokens: List[int] = []
         self.slot = None
+        self.t_submit = time.perf_counter()
 
 
 class ContinuousBatchingSession:
@@ -568,6 +595,7 @@ class ContinuousBatchingSession:
         # reference's block-scheduler makes with its step quantum.
         self._sync_every = max(1, int(sync_every))
         self._pending: List = []
+        self._t_last_drain = None
         # decode_block=k runs k decode steps per DISPATCH in one
         # lax.while_loop program (the DecodeSession block-decode idea
         # applied to the slot batch): one dispatch emits a [slots, k]
@@ -700,10 +728,17 @@ class ContinuousBatchingSession:
         self._used_rids.add(rid)
         self._queue.append(_Request(rid, ids, ids.size,
                                     max_new_tokens))
+        if _met._ENABLED:
+            r = _met.REGISTRY
+            r.counter("serving.requests_submitted").inc()
+            r.gauge("serving.queue_depth").set(len(self._queue))
+            r.gauge("serving.inflight_requests").set(
+                len(self._used_rids))
         return rid
 
     def _admit_ready(self):
         state = [t._data for t in self._state_t]
+        t_admit = time.perf_counter()
         while self._free and self._queue:
             req = self._queue.popleft()
             slot = self._free.pop()
@@ -718,6 +753,18 @@ class ContinuousBatchingSession:
                                 *self._cache_arrays)
             req.slot = slot
             self._running[slot] = req
+            if _met._ENABLED:
+                r = _met.REGISTRY
+                r.counter("serving.admits").inc()
+                r.counter("serving.prefill_tokens").inc(req.plen)
+                dt = time.perf_counter() - t_admit
+                if dt > 0:
+                    # dispatch-side rate: prefill programs are async,
+                    # so this tracks admission throughput, not device
+                    # occupancy
+                    r.gauge("serving.prefill_tokens_per_s").set(
+                        req.plen / dt)
+                t_admit = time.perf_counter()
             # the admit's sampled token is the request's first output;
             # it stays ON DEVICE and is fetched with the next pending
             # drain (an immediate device_get would reintroduce one
@@ -736,6 +783,11 @@ class ContinuousBatchingSession:
             self._free.append(req.slot)
             req.slot = None
             self._done[req.rid] = req
+            if _met._ENABLED:
+                r = _met.REGISTRY
+                r.counter("serving.requests_completed").inc()
+                r.histogram("serving.request_latency_s").observe(
+                    time.perf_counter() - req.t_submit)
 
     def _drain_pending(self):
         if not self._pending:
@@ -743,23 +795,35 @@ class ContinuousBatchingSession:
         entries = self._pending
         self._pending = []
         fetched = jax.device_get([t for (_k, _s, t) in entries])
+        delivered = 0
         for (kind, aslot, _t), row in zip(entries, fetched):
             row = np.asarray(row)
             if kind == "admit":
                 req = self._running.get(aslot)
                 if req is not None:
                     req.tokens.append(int(row[aslot]))
+                    delivered += 1
                     self._maybe_retire(req)
                 continue
             if kind == "block":
                 for col in range(row.shape[1]):
                     for slot, req in list(self._running.items()):
                         req.tokens.append(int(row[slot, col]))
+                        delivered += 1
                         self._maybe_retire(req)
                 continue
             for slot, req in list(self._running.items()):
                 req.tokens.append(int(row[slot]))
+                delivered += 1
                 self._maybe_retire(req)
+        if _met._ENABLED and delivered:
+            now = time.perf_counter()
+            r = _met.REGISTRY
+            r.counter("serving.decode_tokens").inc(delivered)
+            if self._t_last_drain is not None and now > self._t_last_drain:
+                r.gauge("serving.decode_tokens_per_s").set(
+                    delivered / (now - self._t_last_drain))
+            self._t_last_drain = now
 
     def step(self):
         """Admit whatever fits (on sync boundaries), run ONE batched
@@ -769,6 +833,13 @@ class ContinuousBatchingSession:
         before = set(self._done)
         if not self._pending:
             self._admit_ready()
+        if _met._ENABLED:
+            r = _met.REGISTRY
+            r.counter("serving.steps").inc()
+            r.gauge("serving.queue_depth").set(len(self._queue))
+            r.gauge("serving.slots_active").set(len(self._running))
+            r.gauge("serving.slot_utilization").set(
+                len(self._running) / self._slots)
         if self._running:
             state = [t._data for t in self._state_t]
             active = np.zeros((self._slots,), bool)
@@ -795,14 +866,21 @@ class ContinuousBatchingSession:
         (prompt + generated, eos included when emitted) for requests
         completed by THIS drain (or still undelivered from step()
         calls). Delivered results are released — a later run() never
-        re-delivers them, and _done does not grow unboundedly in a
-        long-lived serving session."""
+        re-delivers them, their request_ids become reusable, and
+        neither _done nor _used_rids grows unboundedly in a long-lived
+        serving session."""
         while self._queue or self._running or self._pending:
             self.step()
         out = {rid: np.concatenate([req.ids,
                                     np.asarray(req.tokens, np.int32)])
                for rid, req in self._done.items()}
         self._done = {}
+        # delivered ids leave the in-flight set: a serving loop calling
+        # submit()/run() forever must not accumulate every rid ever seen
+        self._used_rids.difference_update(out)
+        if _met._ENABLED:
+            _met.REGISTRY.gauge("serving.inflight_requests").set(
+                len(self._used_rids))
         return out
 
     def executable_counts(self):

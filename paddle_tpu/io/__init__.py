@@ -23,6 +23,7 @@ import numpy as np
 
 from paddle_tpu.core import generator as gen_mod
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.observability import metrics as _met
 
 
 class Dataset:
@@ -358,6 +359,27 @@ class DataLoader:
                     [self.dataset[i] for i in batch_idx])
 
     def __iter__(self):
+        if not _met._ENABLED:
+            yield from self._iter_batches()
+            return
+        # fetch-wait accounting: how long the consumer (the train loop)
+        # blocks per batch — the input-pipeline stall signal. Covers
+        # every loading mode since it wraps the mode dispatch.
+        hist = _met.REGISTRY.histogram("dataloader.fetch_wait_s")
+        batches = _met.REGISTRY.counter("dataloader.batches")
+        import time as _time
+        inner = self._iter_batches()
+        while True:
+            t0 = _time.perf_counter()
+            try:
+                item = next(inner)
+            except StopIteration:
+                return
+            hist.observe(_time.perf_counter() - t0)
+            batches.inc()
+            yield item
+
+    def _iter_batches(self):
         if not self.use_buffer_reader or self.num_workers == 0:
             yield from self._produce()
             return

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 
 from paddle_tpu.core import generator as gen_mod
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.observability import metrics as _omet
 
 
 def _collect_objects(args):
@@ -330,6 +331,13 @@ class StaticFunction:
         if pad_mask_arg is not None and not self._pad_dynamic:
             raise ValueError(
                 "pad_mask_arg requires pad_dynamic_dims=True")
+        self._fn_sig = None
+        if pad_mask_arg is not None:
+            import inspect
+            try:
+                self._fn_sig = inspect.signature(fn)
+            except (TypeError, ValueError):
+                pass
         self._shape_family = set()
         self._shape_overflow = False
         self._slice_plans = {}
@@ -349,6 +357,29 @@ class StaticFunction:
                     "(pad_dynamic_dims=False)")
         functools.update_wrapper(self, fn, updated=[])
         _static_functions.add(self)
+        # per-function compile-cache telemetry (observability layer);
+        # metric objects are cached here so the hot call path pays one
+        # _ENABLED branch + Counter.inc when metrics are on
+        qn = getattr(fn, "__qualname__", str(fn))
+        self._m_calls = _omet.REGISTRY.counter("jit.fn_calls", fn=qn)
+        self._m_hits = _omet.REGISTRY.counter("jit.fn_cache_hits", fn=qn)
+        self._m_probes = _omet.REGISTRY.counter("jit.fn_probes", fn=qn)
+        self._m_builds = _omet.REGISTRY.counter("jit.fn_builds", fn=qn)
+        self._m_breaks = _omet.REGISTRY.counter(
+            "jit.fn_graph_breaks", fn=qn)
+
+    def _mask_bound_positionally(self, args, kwargs):
+        """True when the call already binds the pad-mask parameter
+        through its positionals — injecting/raising would then be
+        wrong (the mask-missing guard must not fire on callers that
+        pass the mask themselves). The signature is computed once."""
+        if self._fn_sig is None:
+            return False
+        try:
+            bound = self._fn_sig.bind_partial(*args, **kwargs)
+            return self._pad_mask_arg in bound.arguments
+        except TypeError:
+            return False
 
     @staticmethod
     def _parse_dynamic_dims(input_spec):
@@ -517,12 +548,31 @@ class StaticFunction:
             # trace: inline into the enclosing program (the outer
             # context owns the scalarization decisions)
             return self._fn(*args, **kwargs)
+        if _omet._ENABLED:
+            self._m_calls.inc()
         pad_slice = None
         pad_plan = None
         if self._dyn_dims:
             if self._pad_dynamic:
                 unpadded = list(arg_arrays)
                 arg_arrays, pad_slice = self._pad_args(arg_arrays)
+                if self._pad_mask_arg is not None and \
+                        pad_slice is None and \
+                        self._pad_mask_arg not in kwargs and \
+                        not self._mask_bound_positionally(args, kwargs):
+                    # none of the declared dynamic dims bound to this
+                    # call's tensor args AND the caller did not supply
+                    # the mask themselves, so its length is unknowable
+                    # — fail with the contract spelled out instead of
+                    # the fn's TypeError for a missing required kwarg
+                    raise ValueError(
+                        f"pad_mask_arg={self._pad_mask_arg!r}: this "
+                        "call bound none of the input_spec's dynamic "
+                        "(None/-1) dims, so the loss-weight mask's "
+                        "length is unknown. Pass "
+                        f"{self._pad_mask_arg!r} explicitly (all-ones "
+                        "of the true length), or align input_spec "
+                        "with the call's tensor arguments")
                 if self._pad_mask_arg is not None and \
                         pad_slice is not None:
                     # inject the loss-weight mask for the first
@@ -607,6 +657,8 @@ class StaticFunction:
                     spec, state, gen, arg_arrays)
             except GraphBreak:
                 entry["breaks"] += 1
+                if _omet._ENABLED:
+                    self._m_breaks.inc()
                 if not spec.decisions:
                     entry["specs"].pop(idx)        # invalid skeleton
                     entry["mru"] = 0
@@ -633,6 +685,8 @@ class StaticFunction:
                 return result
             if ok:
                 spec.hits += 1
+                if _omet._ENABLED:
+                    self._m_hits.inc()
                 entry["mru"] = idx
                 if pad_slice is not None:
                     result = self._slice_outputs(result, *pad_slice,
@@ -649,6 +703,8 @@ class StaticFunction:
                     break
             if nxt is None:
                 entry["breaks"] += 1
+                if _omet._ENABLED:
+                    self._m_breaks.inc()
                 result = self._probe(entry, meta, args, kwargs)
                 if pad_slice is not None:
                     result = self._slice_outputs(result, *pad_slice,
@@ -682,6 +738,8 @@ class StaticFunction:
         the decision trace; then select or compile the matching
         specialization for future calls."""
         entry["probes"] += 1
+        if _omet._ENABLED:
+            self._m_probes.inc()
         ctx = _ProbeCtx()
         _ctx_stack.append(ctx)
         try:
@@ -734,6 +792,8 @@ class StaticFunction:
         return result
 
     def _build(self, spec, meta, donate):
+        if _omet._ENABLED:
+            self._m_builds.inc()
         fn = self._fn
         outer = self
         decisions = spec.decisions

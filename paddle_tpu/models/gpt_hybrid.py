@@ -495,7 +495,7 @@ def forward_hidden(params, input_ids, cfg: GPTConfig,
                 jnp.where(idx == n - 1, outs, jnp.zeros_like(outs)), "pp")
             return outs
 
-        from jax import shard_map
+        from paddle_tpu.core.compat import shard_map
         blk_specs = jax.tree_util.tree_map(lambda _: P("pp"),
                                            blocks)
         out_mb = shard_map(
@@ -640,7 +640,7 @@ def _train_grads_1f1b(params, batch, cfg, pcfg, mesh):
     GPipe rotation. Embedding runs (and is differentiated) outside the
     pipeline; the head (final LN + logits + CE) is the pipeline's
     last-stage seed, with tied-wte grads summed from both paths."""
-    from jax import shard_map
+    from paddle_tpu.core.compat import shard_map
 
     from paddle_tpu.parallel.pipeline import pipeline_microbatch
     from paddle_tpu.parallel.pipeline_1f1b import pipeline_train_1f1b

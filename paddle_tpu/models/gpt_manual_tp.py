@@ -38,6 +38,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .gpt import GPTConfig
+from paddle_tpu.core.compat import shard_map as _shard_map
 
 
 # ------------------------- block (manual tp) -------------------------
@@ -396,7 +397,7 @@ def train_grads_zb_manual_tp(params, batch, cfg: GPTConfig, pcfg, mesh):
     mb_spec = P(None, None, "tp", None) if pcfg.sp else P(None)
     hp_specs = {"wte": P("tp", None), "lnf_g": P(), "lnf_b": P()}
     dx0_spec = mb_spec
-    loss, bgrads, hgrads, dx0 = jax.shard_map(
+    loss, bgrads, hgrads, dx0 = _shard_map(
         body, mesh=mesh, axis_names={"pp", "tp"},
         in_specs=(blk_specs, mb_spec, P(None), hp_specs),
         out_specs=(P(), blk_specs, hp_specs, dx0_spec))(
@@ -582,7 +583,7 @@ def train_grads_zb_manual_ep(params, batch, cfg: GPTConfig, pcfg,
     blk_specs = _manual_blk_specs(blocks, moe=True)
     mb_spec = P(None, "dp", None, None)
     hp_specs = {"wte": P(), "lnf_g": P(), "lnf_b": P()}
-    loss, bgrads, hgrads, dx0 = jax.shard_map(
+    loss, bgrads, hgrads, dx0 = _shard_map(
         body, mesh=mesh, axis_names={"pp", "dp"},
         in_specs=(blk_specs, mb_spec, P(None, "dp", None), hp_specs),
         out_specs=(P("dp"), blk_specs, hp_specs,

@@ -1,0 +1,119 @@
+"""Compile-cache tracking over jax's jit internals.
+
+Two services:
+
+1. A persistent, transparent hook around XLA compilation
+   (``install()``, idempotent) that counts every executable build into
+   the metrics registry — ``jit.xla_compiles`` — so a production run
+   can answer "how many recompiles so far?" from ``dump()`` alone.
+
+2. ``count_compiles()`` / ``count_traces()`` context managers yielding
+   a CALLABLE count, replacing the drifted
+   ``jax._src.test_util.count_jit_compilation_cache_miss`` API the
+   perf-gate tests were written against (that helper now yields a bare
+   list on this jax, so ``compiles()`` raises TypeError). The
+   mechanism mirrors jtu's: wrap ``pxla._cached_compilation`` for
+   compile events and re-``lu.cache``-wrap ``_create_pjit_jaxpr`` for
+   tracing-cache misses, restoring the original on exit. Nesting with
+   the persistent hook (or with jtu's own counters) composes — each
+   layer delegates to whatever callable it captured.
+
+Per-FUNCTION compile/cache-hit accounting lives in
+``paddle_tpu.jit.StaticFunction`` (calls / probes / graph breaks /
+specializations / XLA executable counts) and is published into the
+registry at snapshot time by the collector in ``observability``.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+from . import metrics as _met
+
+_install_lock = threading.Lock()
+_installed = False
+
+
+class _Count:
+    """Callable current-count (the pre-drift jtu contract: tests do
+    ``with count_compiles() as c: ...; assert c() == 0``)."""
+
+    __slots__ = ("n",)
+
+    def __init__(self):
+        self.n = 0
+
+    def __call__(self) -> int:
+        return self.n
+
+
+def _pxla():
+    from jax._src.interpreters import pxla
+    return pxla
+
+
+def install() -> None:
+    """Wrap XLA compilation once; every compile increments
+    ``jit.xla_compiles`` (when metrics are enabled). Safe to call from
+    import paths — failures (jax internals moved) are swallowed and
+    the registry simply never sees the counter."""
+    global _installed
+    with _install_lock:
+        if _installed:
+            return
+        try:
+            pxla = _pxla()
+            orig = pxla._cached_compilation
+            ctr = _met.REGISTRY.counter("jit.xla_compiles")
+
+            def compile_and_count(*args, **kwargs):
+                if _met._ENABLED:
+                    ctr.inc()
+                return orig(*args, **kwargs)
+
+            pxla._cached_compilation = compile_and_count
+            _installed = True
+        except Exception:
+            pass
+
+
+@contextmanager
+def count_compiles():
+    """Count XLA executable builds (jit compilation-cache misses)
+    within the context; yields a callable returning the count."""
+    pxla = _pxla()
+    orig = pxla._cached_compilation
+    count = _Count()
+
+    def compile_and_count(*args, **kwargs):
+        count.n += 1
+        return orig(*args, **kwargs)
+
+    pxla._cached_compilation = compile_and_count
+    try:
+        yield count
+    finally:
+        pxla._cached_compilation = orig
+
+
+@contextmanager
+def count_traces():
+    """Count jit tracing-cache misses (retraces) within the context;
+    yields a callable returning the count. Repeat calls that hit the
+    tracing cache do not count — the wrapper is itself lu.cache'd,
+    exactly like the jax test-util original."""
+    from jax._src import pjit as pjit_lib
+    from jax._src import linear_util as lu
+    orig = pjit_lib._create_pjit_jaxpr
+    count = _Count()
+
+    @lu.cache
+    def create_pjit_jaxpr_and_count(*args):
+        count.n += 1
+        return orig(*args)
+
+    pjit_lib._create_pjit_jaxpr = create_pjit_jaxpr_and_count
+    try:
+        yield count
+    finally:
+        pjit_lib._create_pjit_jaxpr = orig

@@ -241,8 +241,35 @@ class Profiler:
                 "dur": max(s.end_us - s.start_us, 0.001),
                 "pid": 0, "tid": s.tid,
             })
+        events.extend(self._metric_counter_events())
         with open(path, "w") as f:
             json.dump({"traceEvents": events}, f)
+
+    @staticmethod
+    def _metric_counter_events():
+        """The observability registry snapshot as chrome-tracing counter
+        ('ph':'C') events, so tokens/s, queue depth, compile counts etc.
+        land in the SAME trace as the host spans (the reference's
+        statistic tables riding its chrome export)."""
+        from paddle_tpu.observability import metrics as _met
+        events = []
+        ts = time.perf_counter_ns() / 1e3
+        for d in _met.REGISTRY.snapshot():
+            name = d["name"]
+            if d["labels"]:
+                lab = ",".join(f"{k}={v}"
+                               for k, v in sorted(d["labels"].items()))
+                name = f"{name}{{{lab}}}"
+            if d["type"] == "histogram":
+                args = {"count": d["count"], "sum": d["sum"]}
+                if "p50" in d:
+                    args["p50"] = d["p50"]
+                    args["p99"] = d["p99"]
+            else:
+                args = {"value": d["value"]}
+            events.append({"name": f"metric::{name}", "ph": "C",
+                           "ts": ts, "pid": 0, "args": args})
+        return events
 
     def export(self, path, format="json"):
         self._export_chrome(path)

@@ -136,8 +136,10 @@ def test_sync_every_batched_retirement_same_outputs(tiny_model):
 
 def test_run_delivers_each_request_once(tiny_model):
     """run() returns only undelivered completions and releases them —
-    a second drain never re-delivers (review finding); explicit
-    request_id collisions are refused."""
+    a second drain never re-delivers (review finding); request_id
+    collisions with IN-FLIGHT requests are refused, while delivered
+    ids become reusable (so a long-lived serving session's id set does
+    not grow forever)."""
     m = tiny_model
     rng = np.random.RandomState(31)
     sess = ContinuousBatchingSession(m, max_slots=1, max_length=64)
@@ -148,10 +150,17 @@ def test_run_delivers_each_request_once(tiny_model):
     p2 = rng.randint(0, 256, (6,)).astype(np.int32)
     rid2 = sess.submit(p2, 2)
     assert rid2 != 5
-    out2 = sess.run()
-    assert set(out2) == {rid2}, "earlier results must not re-deliver"
+    # rid2 is in flight: a colliding explicit id is refused
     with pytest.raises(ValueError, match="already in use"):
         sess.submit(p1, 2, request_id=rid2)
+    out2 = sess.run()
+    assert set(out2) == {rid2}, "earlier results must not re-deliver"
+    # delivered ids are released — reuse is allowed and tracked afresh
+    assert sess._used_rids == set()
+    rid3 = sess.submit(p1, 2, request_id=rid2)
+    assert rid3 == rid2
+    out3 = sess.run()
+    assert set(out3) == {rid3}
 
 
 def test_decode_block_mode_same_outputs(tiny_model):

@@ -102,10 +102,19 @@ def test_schedule_mode_zbv_matches_1f1b():
 
 
 def test_schedule_mode_guards():
+    """Unsupported schedule_mode is a TRAIN-path config error: the wrap
+    succeeds (forward/eval-only flows keep working) and the error
+    surfaces at train_batch()."""
     _init_fleet("FThenB")
     model = _make_pipeline_layer()
+    wrapped = fleet.distributed_model(model)
+    x = paddle.to_tensor(np.zeros((4, 8), "f4"))
+    y = wrapped(x)                     # eval path works under FThenB
+    assert y.shape == [4, 4]
     with pytest.raises(ValueError, match="schedule_mode"):
-        fleet.distributed_model(model)
+        wrapped.train_batch(
+            (x, paddle.to_tensor(np.zeros((4,), "int64"))),
+            optimizer=None)
 
 
 def test_heterogeneous_chain_passes_through_with_warning():

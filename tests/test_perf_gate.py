@@ -88,16 +88,20 @@ def test_train_step_executable_count_stable():
     """Steady-state calls of the jitted train step must neither
     RE-TRACE nor RE-COMPILE (a recompile = silent 20-40 s/step cliff).
 
-    Asserted via jax's own event counters over calls 2..4, NOT via
-    PjitFunction._cache_size(): the C++ fastpath-cache entry count
-    measures whether jaxlib *installed its dispatch fastpath*, which
-    late in a long test session can legitimately be declined (observed
-    deterministically after ~750 suite tests with zero retraces, zero
-    recompiles, clean config and an effect-free jaxpr — a jaxlib
-    dispatch-layer heuristic, not a program regression). Counting
-    actual tracing/compilation events pins the invariant that matters
-    and is order-independent."""
-    from jax._src import test_util as jtu
+    Asserted via the framework's compile-cache tracker over calls
+    2..4, NOT via PjitFunction._cache_size(): the C++ fastpath-cache
+    entry count measures whether jaxlib *installed its dispatch
+    fastpath*, which late in a long test session can legitimately be
+    declined (observed deterministically after ~750 suite tests with
+    zero retraces, zero recompiles, clean config and an effect-free
+    jaxpr — a jaxlib dispatch-layer heuristic, not a program
+    regression). Counting actual tracing/compilation events pins the
+    invariant that matters and is order-independent. (Formerly used
+    jtu.count_jit_*_cache_miss, whose yielded object drifted from a
+    callable to a bare list across jax versions —
+    observability.count_traces/count_compiles is the stable
+    framework-owned surface.)"""
+    from paddle_tpu import observability as obs
     cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
                     num_heads=2, max_seq_len=64)
     pcfg = _flagship_pcfg(param_dtype=jnp.float32,
@@ -108,8 +112,8 @@ def test_train_step_executable_count_stable():
     with mesh:
         # warmup call pays the one allowed trace+compile
         params, opt_state, loss = step(params, opt_state, (ids, ids))
-        with jtu.count_jit_tracing_cache_miss() as traces, \
-                jtu.count_jit_compilation_cache_miss() as compiles:
+        with obs.count_traces() as traces, \
+                obs.count_compiles() as compiles:
             for _ in range(3):
                 params, opt_state, loss = step(params, opt_state,
                                                (ids, ids))
@@ -120,7 +124,7 @@ def test_train_step_executable_count_stable():
     # liveness: the counters must SEE a genuine recompile (new shape),
     # or the zero above proves nothing
     with mesh:
-        with jtu.count_jit_tracing_cache_miss() as traces2:
+        with obs.count_traces() as traces2:
             ids2 = jnp.zeros((4, 32), jnp.int32)
             params, opt_state, loss = step(params, opt_state,
                                            (ids2, ids2))
