@@ -40,7 +40,7 @@ def main():
         # "names" remat policy fits v5e 16GB; measured 14.8k tok/s =
         # 1.007x the A100@40%MFU proxy. B8 exceeds memory (compile
         # fails); the smaller 350M config runs at 0.96-0.99x
-        # (benchmarks/_perf_sweep.py history).
+        # (benchmarks/probes/_perf_sweep.py history).
         cfg = GPTConfig(vocab_size=50304, hidden_size=2048, num_layers=24,
                         num_heads=16, max_seq_len=1024)
         batch, seq, steps, warmup = 4, 1024, 8, 2
@@ -108,13 +108,13 @@ def main():
     # 500s on the huge full-unroll HLO and (b) switches to strict AOT
     # hbm accounting under which the f32-moment program (19.2G est.)
     # no longer fits — bf16 moments (~15G) do, with loss parity proven
-    # exact to 1e-6/30 steps (benchmarks/_r3_moment_parity.py).
+    # exact to 1e-6/30 steps (benchmarks/probes/_r3_moment_parity.py).
     # moments=None INHERITS the param dtype (bf16 here) — the exact
     # round-2 configuration all recorded numbers ran under (a round-3
     # f32-moment default briefly inflated the program by 5.2 GB and
     # masqueraded as a tunnel regression — see NOTES). bf16-vs-f32
     # moment parity: 1.45e-6 max rel dev over 30 steps measured,
-    # asserted < 5e-3 (benchmarks/_r3_moment_parity.py). Later rungs
+    # asserted < 5e-3 (benchmarks/probes/_r3_moment_parity.py). Later rungs
     # trade throughput for memory headroom.
     attempts = [(cfg.num_layers, None, "names"),
                 (1, None, "names"),
@@ -290,7 +290,14 @@ def main():
 
         # long-context rungs: the NOTES-validated 350M-class model
         # (h1024/L24/heads8) at S=2048 and S=4096 — exercises the
-        # causal-skip attention kernel's VMEM-adaptive dispatch
+        # attention-kernel dispatch chain (causal-skip at S=2048, the
+        # q×kv-blocked flash kernel at S=4096).  Each rung records the
+        # autotuner's winner for its attention shape, and train_s4096
+        # records the s4096/s1024 MFU *ratio* — drift-robust against
+        # the tunnel's intra-day transport weather, so the long-context
+        # regression gate can pin the ratio rather than an absolute.
+        flagship_mfu = _mfu(tokens_per_sec,
+                            _gpt_flops_per_token(cfg, seq))
         for name, s_, b_ in (("train_s2048", 2048, 4),
                              ("train_s4096", 4096, 2)):
             if not _want(name):
@@ -299,7 +306,24 @@ def main():
                 c = GPTConfig(vocab_size=50304, hidden_size=1024,
                               num_layers=24, num_heads=8,
                               max_seq_len=s_)
+                # eager pre-measure so the winner is in the table when
+                # the train step TRACES the dispatch (trace-time decide
+                # is table-lookup-only — autotune.py header)
+                attn_kernel = None
+                try:
+                    from paddle_tpu.ops.pallas import autotune as _at
+                    hd = c.hidden_size // c.num_heads
+                    attn_kernel = _at.measure(
+                        (b_, s_, c.num_heads, hd), s_, jnp.bfloat16,
+                        True)
+                except Exception as ae:  # noqa: BLE001
+                    attn_kernel = f"measure_error: {type(ae).__name__}"
+                _cleanup()
                 _train_rung(name, c, b_, s_)
+                rungs[name]["attn_kernel"] = attn_kernel
+                if name == "train_s4096" and flagship_mfu:
+                    rungs[name]["mfu_ratio_vs_s1024"] = round(
+                        rungs[name]["mfu"] / flagship_mfu, 4)
             except Exception as e:  # noqa: BLE001
                 rungs[name] = {"error": f"{type(e).__name__}: {e}"}
             _cleanup()
@@ -357,7 +381,7 @@ def main():
 
         # decode rung: GPT-1.3B serving throughput (per-step decode
         # path, B8, bf16 weights) — the exact round-4 on-chip
-        # configuration (benchmarks/_decode_bench.py), recorded
+        # configuration (benchmarks/probes/_decode_bench.py), recorded
         try:
             if not _want("decode_gpt1.3b_b8"):
                 raise _SkipRung()

@@ -6,7 +6,7 @@ reports aggregate generated tokens/sec, vs the static-batch
 DecodeSession on the same model as the ceiling.
 
 Run ON TPU (no env overrides — let axon provide the chip):
-    PYTHONPATH=/root/repo python benchmarks/_cb_bench.py
+    PYTHONPATH=/root/repo python benchmarks/probes/_cb_bench.py
 """
 import os
 import time

@@ -27,7 +27,7 @@ expressibility limit of the nested-region formulation used by the
 GSPMD-auto-tp engines.
 
 Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
-     python benchmarks/_cm_repro.py
+     python benchmarks/probes/_cm_repro.py
 Expected: a Shardy/vma error at trace/compile time (NOT a crash and
 NOT success). Success means the upstream wall has cleared — then flip
 gpt_hybrid._use_cm's pp==1 gate and planner.collective_matmul.

@@ -1,6 +1,6 @@
 """Decode tokens/s probe for the static-cache serving path.
 
-Run on the real chip: `python benchmarks/_decode_bench.py [size]`
+Run on the real chip: `python benchmarks/probes/_decode_bench.py [size]`
 size: tiny (default, CPU-safe) | 1.3b (GPT-1.3B-shaped, needs TPU HBM)
 
 Reports prefill latency, per-token decode latency and tokens/s, and the

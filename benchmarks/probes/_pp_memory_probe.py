@@ -4,7 +4,7 @@ the VERDICT round-1 item-6 measurement.
 
 Run on the 8-virtual-device CPU mesh:
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-        python benchmarks/_pp_memory_probe.py [M] [HID]
+        python benchmarks/probes/_pp_memory_probe.py [M] [HID]
 
 Reports XLA's compiled temp-buffer sizes (memory_analysis()) per
 variant, plus the analytic live-activation counts from the schedule

@@ -21,7 +21,7 @@ def main():
 
     # bf16 moments: halves optimizer state (fits the grad accumulator
     # in HBM) — loss parity proven exact-to-1e-6 over 30 steps
-    # (benchmarks/_r3_moment_parity.py)
+    # (benchmarks/probes/_r3_moment_parity.py)
     pcfg = GH.ParallelConfig(dp=1, pp=1, tp=1, remat=True,
                              remat_policy="names", scan_unroll=24,
                              param_dtype=jnp.bfloat16,

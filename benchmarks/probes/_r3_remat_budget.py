@@ -4,7 +4,7 @@ With f32 moments the static state is 13 GB of 15.75 and 'names' (3
 saved tensors/layer) was the remat optimum. bf16 moments cut state to
 7.8 GB; this probes whether the freed 5+ GB buys back the ~recompute
 cost via richer save policies. Run one variant per process:
-  VARIANT=names|names5|dots|nof32names  python benchmarks/_r3_remat_budget.py
+  VARIANT=names|names5|dots|nof32names  python benchmarks/probes/_r3_remat_budget.py
 """
 import os
 import sys

@@ -6,7 +6,7 @@ measurements extended to the V schedule.
 1F1B/ZBH1 run 4 stages x 2 layers; ZB-V runs the same 8 layers as 8
 V-placed virtual stages (1 layer each). Run:
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-        python benchmarks/_r4_zb_probe.py [M] [HID]
+        python benchmarks/probes/_r4_zb_probe.py [M] [HID]
 """
 import os
 import sys

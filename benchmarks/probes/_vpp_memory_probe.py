@@ -1,7 +1,7 @@
 """VPP vs 1F1B compiled temp-memory probe (VERDICT r3 item 5 evidence).
 
 Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-     python benchmarks/_vpp_memory_probe.py
+     python benchmarks/probes/_vpp_memory_probe.py
 
 Measured (CPU mesh, pp=4, M=8, h=256, L=32, S=128, remat off):
     1f1b: temp=96.73MB

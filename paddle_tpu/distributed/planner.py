@@ -90,7 +90,7 @@ class PlanCandidate:
         the GSPMD engine runs the ring via a top-level tp shard_map;
         at pp>1 it rides the manual-tp stage body (round 5 —
         models/gpt_manual_tp.py; the nested-region formulation stays
-        Shardy-walled, benchmarks/_cm_repro.py). Consumed by
+        Shardy-walled, benchmarks/probes/_cm_repro.py). Consumed by
         to_parallel_config()."""
         return self.sp and self.tp > 1
 

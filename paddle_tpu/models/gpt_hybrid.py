@@ -88,7 +88,7 @@ class ParallelConfig:
     # pp==1: GSPMD route via a top-level tp shard_map (_use_cm).
     # pp>1 (round 5): manual-tp 1F1B route — needs sp, tp>1,
     # vpp_chunks=1, no MoE, fused_ce=False (the nested-region
-    # formulation stays Shardy-walled, benchmarks/_cm_repro.py).
+    # formulation stays Shardy-walled, benchmarks/probes/_cm_repro.py).
     # Incompatible with the zero-bubble schedules (whole-mesh ppermute
     # in a cond-gated phase — _validate_pp_schedule refuses)
     collective_matmul: bool = False
@@ -99,7 +99,7 @@ class ParallelConfig:
     # doubles moment HBM (+5.2 GB at 1.3B — does NOT fit v5e alongside
     # the step's working set); parity of bf16 vs f32 moments measured
     # at 1.45e-6 max rel deviation over 30 steps
-    # (benchmarks/_r3_moment_parity.py, asserted < 5e-3)
+    # (benchmarks/probes/_r3_moment_parity.py, asserted < 5e-3)
     moment_dtype: Any = None
     fused_ce: bool = True     # chunked LM-head+CE (ops/fused_ce.py);
                               # never materializes [T, V] logits
@@ -395,7 +395,7 @@ def _stack_apply(blocks, x, cfg, pcfg, mesh):
                 # surgical: keep the expensive tensors (attention
                 # output, qkv, ffn up-projection), recompute the cheap
                 # rest — the flash kernel never re-runs in backward.
-                # Measured best on v5e (benchmarks/_e2e_h8*.py); saving
+                # Measured best on v5e (benchmarks/probes/_e2e_h8*.py); saving
                 # proj/ffn2 as well LOWERS throughput (memory pressure)
                 fn = jax.checkpoint(
                     fn, policy=jax.checkpoint_policies
@@ -649,7 +649,7 @@ def _train_grads_1f1b(params, batch, cfg, pcfg, mesh):
             and pcfg.num_experts > 0 and pcfg.dp > 1:
         # zero-bubble x EP-MoE: the manual-ep stage body (explicit
         # all-to-all over the manual dp axis — in-branch legal, probe
-        # leg F in benchmarks/_r5_cond_collective_probe.py)
+        # leg F in benchmarks/probes/_r5_cond_collective_probe.py)
         from paddle_tpu.models.gpt_manual_tp import \
             train_grads_zb_manual_ep
         return train_grads_zb_manual_ep(params, batch, cfg, pcfg, mesh)
@@ -671,7 +671,7 @@ def _train_grads_1f1b(params, batch, cfg, pcfg, mesh):
         #   (round-4 wall; round-5 manual-tp formulation);
         # - 1F1B + collective_matmul + sp at pp>1: the ring collective
         #   matmuls need tp manual at the SAME level as pp (the nested
-        #   formulation is Shardy-walled, benchmarks/_cm_repro.py)
+        #   formulation is Shardy-walled, benchmarks/probes/_cm_repro.py)
         from paddle_tpu.models.gpt_manual_tp import \
             train_grads_zb_manual_tp
         return train_grads_zb_manual_tp(params, batch, cfg, pcfg, mesh)
@@ -787,7 +787,7 @@ def _validate_pp_schedule(pcfg):
             "collective-permute spanning the whole mesh, and inside a "
             "cond-gated phase the idle pipeline stages never reach it "
             "(cross-matched data or rendezvous deadlock — "
-            "benchmarks/_r5_cond_collective_probe.py leg E). Use "
+            "benchmarks/probes/_r5_cond_collective_probe.py leg E). Use "
             "pp_schedule='1f1b' for the ring under pp>1, or drop "
             "collective_matmul for zero-bubble.")
     if pcfg.collective_matmul and pcfg.pp > 1 and not (
@@ -962,7 +962,7 @@ def build_leaf_accum_bench(cfg: GPTConfig, pcfg: ParallelConfig,
     The per-k apply also amortizes the bandwidth-bound AdamW update —
     a larger-global-batch pretrain config (update math identical to
     adamw_update; k=1 reproduces the classic step exactly, see
-    benchmarks/_r3_flat_parity.py).
+    benchmarks/probes/_r3_flat_parity.py).
     """
     grad_acc = _make_grad_acc(cfg, pcfg, mesh)
 
@@ -1058,7 +1058,7 @@ def build_flat_accum_bench(cfg: GPTConfig, pcfg: ParallelConfig,
           per k chunks — which also amortizes the bandwidth-bound
           optimizer (~25 ms) by k (a larger-global-batch pretrain
           config; loss-parity of bf16 moments proven in
-          benchmarks/_r3_moment_parity.py).
+          benchmarks/probes/_r3_moment_parity.py).
     """
     tpl = jax.eval_shape(
         lambda: init_params(cfg, pcfg, jax.random.PRNGKey(0)))

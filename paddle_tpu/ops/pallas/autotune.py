@@ -4,9 +4,12 @@ Reference being re-designed: phi/kernels/autotune/{auto_tune_base.h,
 cache.cc,switch_autotune.cc} — run each candidate kernel once with a
 GPU timer, cache the winner keyed by shape, re-use thereafter.
 
-TPU-native version: the candidates are the three in-tree Pallas
-attention kernels plus the jax library flash kernel plus plain XLA
-attention. A measurement times fwd+bwd (the kernels live inside
+TPU-native version: the candidates are the three monolithic in-tree
+Pallas attention kernels, the q×kv-blocked flash kernel (one candidate
+per (bq, bkv) block-size variant — `blocked_bq512_bkv512` etc., so
+block sizes are autotuned along with the kernel choice), the jax
+library flash kernel, and plain XLA attention. A measurement times
+fwd+bwd (the kernels live inside
 training steps) under jit with a scalar readback sync (the tunneled
 PJRT backend acks block_until_ready early — NOTES.md). Winners are
 cached per (device_kind, B, H, S, Skv, D, dtype, causal) in memory and
@@ -21,6 +24,7 @@ from __future__ import annotations
 import json
 import math
 import os
+import re
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -42,6 +46,14 @@ _RUNNERS = None
 _table: Optional[Dict[str, dict]] = None
 
 
+def _bhsd(run):
+    """[B,S,H,D] entry -> [B,H,S,D] kernel-layout runner."""
+    def wrapped(q, k, v, causal, scale):
+        qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+        return jnp.swapaxes(run(qt, kt, vt, causal, scale), 1, 2)
+    return wrapped
+
+
 def _cache_path() -> str:
     base = os.environ.get("PADDLE_TPU_CACHE_DIR")
     if base is None:
@@ -50,27 +62,40 @@ def _cache_path() -> str:
     return os.path.join(base, "attn_autotune.json")
 
 
+def _read_disk_table(path: str) -> Dict[str, dict]:
+    """Best-effort read; a corrupted / partially written / wrong-schema
+    file degrades to {} (the static chain) instead of raising."""
+    try:
+        with open(path) as f:
+            tab = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return tab if isinstance(tab, dict) else {}
+
+
 def _load_table() -> Dict[str, dict]:
     global _table
     if _table is None:
-        _table = {}
-        try:
-            with open(_cache_path()) as f:
-                _table = json.load(f)
-        except (OSError, ValueError):
-            pass
+        _table = _read_disk_table(_cache_path())
     return _table
 
 
 def _save_table() -> None:
+    global _table
     path = _cache_path()
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
+        # merge-then-replace: re-read the file so winners measured by a
+        # concurrent process since our load are kept (our entries win
+        # on key collision), and write via temp file + os.replace so a
+        # concurrent reader can never observe a partial write
+        merged = _read_disk_table(path)
+        merged.update(_table)
+        _table = merged
         tmp = f"{path}.{os.getpid()}.tmp"
         with open(tmp, "w") as f:
-            json.dump(_table, f, indent=1, sort_keys=True)
-        os.replace(tmp, path)       # atomic: concurrent writers cannot
-        # interleave into corrupt JSON (last writer wins whole-file)
+            json.dump(merged, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
     except OSError:
         pass                        # read-only FS: in-memory cache only
 
@@ -98,12 +123,6 @@ def _runners():
     from paddle_tpu.ops.pallas import simple_attention2 as sa2
     from paddle_tpu.ops.pallas import flash_attention as fa
 
-    def _bhsd(run):
-        def wrapped(q, k, v, causal, scale):
-            qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
-            return jnp.swapaxes(run(qt, kt, vt, causal, scale), 1, 2)
-        return wrapped
-
     def _xla(q, k, v, causal, scale):
         d = q.shape[-1]
         sm = scale if scale is not None else 1.0 / math.sqrt(d)
@@ -128,8 +147,28 @@ def _runners():
     return _RUNNERS
 
 
+_BLOCKED_RE = re.compile(r"^blocked_bq(\d+)_bkv(\d+)$")
+
+
+def blocked_name(bq: int, bkv: int) -> str:
+    return f"blocked_bq{bq}_bkv{bkv}"
+
+
+def _resolve(name: str):
+    """Runner for a candidate name; blocked variants carry their block
+    sizes in the name so the winner cache pins (kernel, bq, bkv)."""
+    m = _BLOCKED_RE.match(name)
+    if m is None:
+        return _runners()[name]
+    bq, bkv = int(m.group(1)), int(m.group(2))
+    from paddle_tpu.ops.pallas import blocked_flash as bf
+    return _bhsd(lambda q, k, v, c, s: bf.attention_bhsd(
+        q, k, v, causal=c, scale=s, block_q=bq, block_kv=bkv))
+
+
 def candidates(bshd, skv, dtype, causal) -> List[str]:
     """Kernels whose shape gates accept this problem ([B,S,H,D])."""
+    from paddle_tpu.ops.pallas import blocked_flash as bf
     from paddle_tpu.ops.pallas import causal_attention as cak
     from paddle_tpu.ops.pallas import flash_attention as fa
     from paddle_tpu.ops.pallas import simple_attention as sa
@@ -144,6 +183,9 @@ def candidates(bshd, skv, dtype, causal) -> List[str]:
             out.append("causal_skip")
         if sa2.supported(bhsd, dtype):
             out.append("qblock")
+    if bf.supported(bhsd, skv, dtype, causal):
+        out.extend(blocked_name(bq, bkv)
+                   for bq, bkv in bf.block_candidates(s, skv))
     if fa.supported_shape(bshd, skv, dtype):
         out.append("library_flash")
     out.append("xla")
@@ -153,7 +195,7 @@ def candidates(bshd, skv, dtype, causal) -> List[str]:
 def _time_candidate(name: str, q, k, v, causal, scale,
                     reps: int = 3) -> float:
     """fwd+bwd wall time per rep; inf when the kernel fails."""
-    run = _runners()[name]
+    run = _resolve(name)
 
     def fb(q, k, v):
         out, vjp = jax.vjp(lambda a, b, c: run(a, b, c, causal, scale),
@@ -178,8 +220,9 @@ def measure(bshd, skv, dtype, causal, scale=None) -> str:
     the winner in the (persisted) table, return its name."""
     tab = _load_table()
     key = _key(bshd, skv, dtype, causal)
-    if key in tab:
-        return tab[key]["winner"]
+    hit = lookup(bshd, skv, dtype, causal)   # schema-validated; a
+    if hit is not None:                      # wrong-schema entry gets
+        return hit                           # re-measured + rewritten
     b, s, h, d = bshd
     rng = jax.random.PRNGKey(0)
     kq, kk, kv = jax.random.split(rng, 3)
@@ -200,7 +243,12 @@ def measure(bshd, skv, dtype, causal, scale=None) -> str:
 
 def lookup(bshd, skv, dtype, causal) -> Optional[str]:
     ent = _load_table().get(_key(bshd, skv, dtype, causal))
-    return None if ent is None else ent["winner"]
+    # schema-validate: a hand-edited or partially merged entry must
+    # degrade to the static chain, not crash dispatch
+    if not isinstance(ent, dict) or not isinstance(
+            ent.get("winner"), str):
+        return None
+    return ent["winner"]
 
 
 def decide(q, k, causal) -> Optional[str]:
@@ -236,4 +284,4 @@ def decide(q, k, causal) -> Optional[str]:
 
 
 def run(name: str, q, k, v, causal, scale):
-    return _runners()[name](q, k, v, causal, scale)
+    return _resolve(name)(q, k, v, causal, scale)

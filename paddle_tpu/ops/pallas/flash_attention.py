@@ -29,6 +29,27 @@ def _supported(q, k, v):
     return supported_shape(tuple(q.shape), k.shape[1], q.dtype)
 
 
+def _gate_reason(q, k):
+    """Why the library-flash shape gate rejected ([B,S,H,D] inputs) —
+    the label on the attn.dispatch_fallback counter."""
+    if q.shape[-1] % 64 != 0:
+        return "head_dim"           # not a multiple of the lane width
+    if q.shape[1] % 128 != 0 or k.shape[1] % 128 != 0:
+        return "seq_len"
+    return "dtype"
+
+
+def _count(metric, **labels):
+    """Trace-time dispatch counter (single-branch no-op when telemetry
+    is off; never lets an observability failure break dispatch)."""
+    try:
+        from paddle_tpu import observability as obs
+        if obs.enabled():
+            obs.counter(metric, **labels).inc()
+    except Exception:
+        pass
+
+
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None):
     """q/k/v: [B, S, H, D] (paddle flash-attn layout) -> [B, S, H, D]."""
@@ -41,7 +62,7 @@ def flash_attention(q, k, v, causal: bool = False,
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     s_q, s_k = qt.shape[2], kt.shape[2]
-    # tuned on v5e (benchmarks/_attn_chain*.py): 512 blocks win over
+    # tuned on v5e (benchmarks/probes/_attn_chain*.py): 512 blocks win over
     # 1024 (VMEM pressure in the dkv/dq kernels); head_dim >= 128 is
     # what keeps the MXU full — the model zoo defaults to 128-dim heads
     bq = min(512, s_q)
@@ -58,18 +79,27 @@ def flash_attention(q, k, v, causal: bool = False,
 
 
 def flash_attention_maybe(q, k, v, causal=False, scale=None):
-    """Pallas kernel when on TPU with supported shapes, else None.
+    """Pallas kernel when on TPU with supported shapes, else None
+    (None routes the caller to plain XLA attention — shapes the gates
+    reject, e.g. a head dim that is not a multiple of the 64-lane
+    width, FALL BACK rather than raise, and the fallback is counted on
+    the ``attn.dispatch_fallback`` observability counter).
 
-    Two kernels: for sequences whose whole (b, h) slice fits VMEM the
-    monolithic simple_attention kernel wins (1.33 vs 2.31 ms/layer
-    fwd+bwd at B8/S1024/D128 on v5e — benchmarks/_simple_attn_bench.py);
-    longer sequences stream through the library flash kernel."""
+    Static chain (v5e measurements; the autotune table, when warm,
+    overrides it): monolithic simple kernel where the whole (b, h)
+    slice fits VMEM (S<=1024), causal-skip strip kernel where the
+    [S,S] scores no longer fit (S<=2048), q-block kernel for the
+    non-causal middle tier, then the q×kv-blocked flash kernel for the
+    MAC-bound long-S regime (S>=4096 — VMEM residency O(block^2), no
+    S-cap), with the jax library flash kernel as the final tier."""
     try:
         if jax.default_backend() != "tpu":
             return None
         if not _supported(q, k, v):
+            _count("attn.dispatch_fallback", reason=_gate_reason(q, k))
             return None
         from paddle_tpu.ops.pallas import autotune
+        from paddle_tpu.ops.pallas import blocked_flash as bfk
         from paddle_tpu.ops.pallas import causal_attention as cak
         from paddle_tpu.ops.pallas import simple_attention as sa
         from paddle_tpu.ops.pallas import simple_attention2 as sa2
@@ -77,6 +107,7 @@ def flash_attention_maybe(q, k, v, causal=False, scale=None):
         # takes precedence over the static chain below
         tuned = autotune.decide(q, k, causal)
         if tuned is not None:
+            _count("attn.dispatch", kernel=tuned)
             if tuned == "xla":
                 return None
             return autotune.run(tuned, q, k, v, causal, scale)
@@ -91,6 +122,7 @@ def flash_attention_maybe(q, k, v, causal=False, scale=None):
             qt = jnp.swapaxes(q, 1, 2)
             kt = jnp.swapaxes(k, 1, 2)
             vt = jnp.swapaxes(v, 1, 2)
+            _count("attn.dispatch", kernel="simple")
             out = sa.attention_bhsd(qt, kt, vt, causal=causal,
                                     scale=scale)
             return jnp.swapaxes(out, 1, 2)
@@ -99,19 +131,34 @@ def flash_attention_maybe(q, k, v, causal=False, scale=None):
             qt = jnp.swapaxes(q, 1, 2)
             kt = jnp.swapaxes(k, 1, 2)
             vt = jnp.swapaxes(v, 1, 2)
+            _count("attn.dispatch", kernel="causal_skip")
             out = cak.attention_bhsd(qt, kt, vt, causal=True,
                                      scale=scale)
             return jnp.swapaxes(out, 1, 2)
         if q.shape[1] == k.shape[1] and sa2.supported(bhsd, q.dtype):
             # middle tier: q streams in blocks, k/v whole in VMEM
             # (3.30 vs 3.64 ms/layer vs library flash at S=2048 —
-            # benchmarks/_qblock_bench.py)
+            # benchmarks/probes/_qblock_bench.py)
             qt = jnp.swapaxes(q, 1, 2)
             kt = jnp.swapaxes(k, 1, 2)
             vt = jnp.swapaxes(v, 1, 2)
+            _count("attn.dispatch", kernel="qblock")
             out = sa2.attention_bhsd(qt, kt, vt, causal=causal,
                                      scale=scale)
             return jnp.swapaxes(out, 1, 2)
+        if bfk.supported(bhsd, k.shape[1], q.dtype, causal):
+            # long-S tier: every monolithic gate above has rejected
+            # (S>=4096 at D128) — q×kv-blocked online-softmax kernel
+            # with static causal block-skipping
+            qt = jnp.swapaxes(q, 1, 2)
+            kt = jnp.swapaxes(k, 1, 2)
+            vt = jnp.swapaxes(v, 1, 2)
+            _count("attn.dispatch", kernel="blocked")
+            out = bfk.attention_bhsd(qt, kt, vt, causal=causal,
+                                     scale=scale)
+            return jnp.swapaxes(out, 1, 2)
+        _count("attn.dispatch", kernel="library_flash")
         return flash_attention(q, k, v, causal=causal, scale=scale)
     except Exception:
+        _count("attn.dispatch_fallback", reason="error")
         return None

@@ -38,6 +38,20 @@ unshim_axon(pop_tpu=False)
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# tests/ top level carries only test modules plus these two helpers.
+# One-off measurement probes (the `_*.py` scripts that used to pollute
+# the tests dir and its grep results) live in benchmarks/probes/ where
+# pytest never collects them; this guard keeps it that way.
+_ALLOWED_NON_TEST = {"conftest.py", "op_test.py"}
+_strays = sorted(
+    f for f in os.listdir(os.path.dirname(os.path.abspath(__file__)))
+    if f.endswith(".py") and not f.startswith("test_")
+    and f not in _ALLOWED_NON_TEST)
+if _strays:
+    raise RuntimeError(
+        "non-test modules at tests/ top level: %s — move one-off "
+        "probe scripts to benchmarks/probes/" % ", ".join(_strays))
+
 
 @pytest.fixture(autouse=True)
 def _seeded():

@@ -31,15 +31,24 @@ def test_candidates_shape_gating():
     c = autotune.candidates((2, 2048, 8, 128), 2048, jnp.bfloat16, True)
     assert "simple" not in c
     assert "causal_skip" in c or "qblock" in c
-    # S=4096: every monolithic Pallas gate rejects; streaming only
+    # S=4096: every monolithic Pallas gate rejects; only the streaming
+    # kernels remain — the q×kv-blocked variants (block sizes in the
+    # candidate name), the library kernel, and xla
     c = autotune.candidates((2, 4096, 8, 128), 4096, jnp.bfloat16, True)
-    assert set(c) <= {"library_flash", "xla"}
+    assert not {"simple", "causal_skip", "qblock"} & set(c)
+    assert "blocked_bq512_bkv512" in c and "blocked_bq256_bkv512" in c
+    assert c.index("blocked_bq512_bkv512") < c.index("library_flash")
     # non-causal drops the causal-skip kernel
     c = autotune.candidates((2, 2048, 8, 128), 2048, jnp.bfloat16, False)
     assert "causal_skip" not in c
-    # cross attention (S != Skv): only library flash / xla
+    # cross attention (S != Skv): streaming kernels only (the blocked
+    # kernel takes non-causal cross-attn; causal cross-attn it gates
+    # out)
     c = autotune.candidates((2, 512, 8, 128), 1024, jnp.bfloat16, False)
-    assert set(c) <= {"library_flash", "xla"}
+    assert not {"simple", "causal_skip", "qblock"} & set(c)
+    assert "blocked_bq512_bkv512" in c
+    c = autotune.candidates((2, 512, 8, 128), 1024, jnp.bfloat16, True)
+    assert not any(n.startswith("blocked") for n in c)
     # odd head dim: xla only
     c = autotune.candidates((2, 512, 8, 80), 512, jnp.float32, True)
     assert c == ["xla"]
@@ -48,8 +57,9 @@ def test_candidates_shape_gating():
 def test_measure_picks_fastest_and_persists(monkeypatch):
     fake = {"simple": 2.0, "causal_skip": 0.5, "qblock": 1.0,
             "library_flash": 3.0, "xla": 9.0}
+    # blocked_bq*_bkv* variants and any future candidate: slower
     monkeypatch.setattr(autotune, "_time_candidate",
-                        lambda name, *a, **k: fake[name])
+                        lambda name, *a, **k: fake.get(name, 7.0))
     monkeypatch.setattr(autotune, "_device_kind", lambda: "testchip")
     win = autotune.measure((2, 2048, 8, 128), 2048, jnp.bfloat16, True)
     assert win == "causal_skip"
@@ -113,6 +123,83 @@ def test_decide_cpu_backend_never_measures(monkeypatch):
     q = jnp.zeros((2, 512, 8, 128), jnp.float32)
     assert autotune.decide(q, q, True) is None
     assert not calls                # backend is cpu in the test env
+
+
+def test_blocked_candidate_name_roundtrip():
+    # the winner cache pins (kernel, bq, bkv) through the name alone
+    assert autotune.blocked_name(512, 1024) == "blocked_bq512_bkv1024"
+    assert callable(autotune._resolve("blocked_bq128_bkv256"))
+    with pytest.raises(KeyError):
+        autotune._resolve("blocked_bq128")      # malformed: not a
+    with pytest.raises(KeyError):               # known static runner
+        autotune._resolve("no_such_kernel")
+
+
+def test_corrupted_cache_falls_back_to_static_chain(monkeypatch):
+    import os
+    path = autotune._cache_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    # a partial/interleaved write: invalid JSON
+    with open(path, "w") as f:
+        f.write('{"v5e|B2S4096H8D128Skv4096|bfloat16|causal=Tr')
+    assert autotune._load_table() == {}
+    assert autotune.lookup((2, 4096, 8, 128), 4096,
+                           jnp.bfloat16, True) is None
+    # decide() on the corrupted table: None -> static chain
+    q = jnp.zeros((2, 512, 8, 128), jnp.float32)
+    assert autotune.decide(q, q, True) is None
+    # valid JSON, wrong schema (hand-edited / foreign tool): each bad
+    # entry degrades to the static chain instead of crashing dispatch
+    monkeypatch.setattr(autotune, "_device_kind", lambda: "testchip")
+    key = autotune._key((1, 512, 4, 128), 512, jnp.float32, True)
+    autotune._table = None
+    with open(path, "w") as f:
+        json.dump({key: "qblock", "other": {"no_winner": 1}}, f)
+    assert autotune.lookup((1, 512, 4, 128), 512,
+                           jnp.float32, True) is None
+    monkeypatch.setattr(autotune, "_time_candidate",
+                        lambda name, *a, **k: 1.0 if name == "simple"
+                        else 5.0)
+    # measure() over a wrong-schema entry re-measures and rewrites it
+    # (it must not trust the unvalidated cache hit)
+    assert autotune.measure((1, 512, 4, 128), 512,
+                            jnp.float32, True) == "simple"
+    # measure() over the top of a corrupted file rewrites it valid
+    autotune._table = None
+    with open(path, "w") as f:
+        f.write("not json at all")
+    assert autotune.measure((1, 512, 4, 128), 512,
+                            jnp.float32, True) == "simple"
+    with open(path) as f:
+        assert json.load(f)[key]["winner"] == "simple"
+
+
+def test_concurrent_writers_merge_not_clobber(monkeypatch):
+    """Two processes measuring different shapes on one host: each save
+    is atomic (temp + os.replace, no partial interleave) and re-merges
+    the file, so neither winner is lost whatever the write order."""
+    monkeypatch.setattr(autotune, "_device_kind", lambda: "testchip")
+    monkeypatch.setattr(autotune, "_time_candidate",
+                        lambda name, *a, **k: 1.0 if name == "simple"
+                        else 5.0)
+    key_a = autotune._key((1, 512, 4, 128), 512, jnp.float32, True)
+    key_b = autotune._key((2, 512, 4, 128), 512, jnp.float32, True)
+    # process A measures shape A and persists
+    autotune.measure((1, 512, 4, 128), 512, jnp.float32, True)
+    # process B loaded BEFORE A's write (empty table), measures shape
+    # B, then persists — without merge-on-save this would clobber A
+    autotune._table = {}
+    autotune.measure((2, 512, 4, 128), 512, jnp.float32, True)
+    with open(autotune._cache_path()) as f:
+        tab = json.load(f)              # file is valid JSON throughout
+    assert tab[key_a]["winner"] == "simple"
+    assert tab[key_b]["winner"] == "simple"
+    # a reader process (fresh table) sees both winners
+    autotune._table = None
+    assert autotune.lookup((1, 512, 4, 128), 512,
+                           jnp.float32, True) == "simple"
+    assert autotune.lookup((2, 512, 4, 128), 512,
+                           jnp.float32, True) == "simple"
 
 
 def test_runner_numerics_xla_vs_simple_interpret():

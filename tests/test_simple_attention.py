@@ -1,5 +1,5 @@
 """Monolithic Pallas attention kernel numerics (interpret mode on CPU;
-the on-device win is recorded in benchmarks/_simple_attn_bench.py:
+the on-device win is recorded in benchmarks/probes/_simple_attn_bench.py:
 1.33 vs 2.31 ms/layer fwd+bwd against the library flash kernel)."""
 import math
 
