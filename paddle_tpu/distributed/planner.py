@@ -170,6 +170,11 @@ def _spread(vals: List[int], k: int) -> List[int]:
 
 
 class Planner:
+    #: usable fraction of HBM when judging feasibility — the bench
+    #: runs within ~5% of HBM (B8 OOMs, B4 fits). Shared with the
+    #: auto-tuner's prune_by_planner so the two rules cannot drift.
+    hbm_feasible_frac = 0.95
+
     def __init__(self, chip: str = "v5e", mfu: Optional[float] = None,
                  hbm_bytes: Optional[float] = None,
                  zero_stages: Sequence[int] = (0, 1, 2, 3)):
@@ -290,6 +295,38 @@ class Planner:
         return c
 
     # ----------------------------------------------------------- search
+    def refusal_reason(self, m: ModelSpec, n_chips: int,
+                       global_batch: int, *, dp: int, tp: int, pp: int,
+                       microbatches: int = 1,
+                       zero: int = 0) -> Optional[str]:
+        """Why a config lies outside candidates()' structural space
+        (None = legal). The SINGLE home of the legality rules: both
+        candidates() enumeration below and the auto-tuner's
+        prune_by_planner answer from here, and the lockstep test
+        (test_auto_tuner_telemetry) pins that every enumerated
+        candidate passes."""
+        if dp * tp * pp != n_chips:
+            return "mesh_mismatch"
+        if tp > 8:
+            return "tp_gt_8"
+        if m.heads % tp or m.hidden % tp:
+            return "tp_indivisible"
+        if m.layers % pp:
+            return "pp_indivisible"
+        if global_batch % dp:
+            return "dp_indivisible"
+        if pp == 1:
+            if microbatches != 1:
+                return "microbatches_without_pp"
+        else:
+            if microbatches < pp:
+                return "microbatches_lt_pp"
+            if (global_batch // dp) % microbatches:
+                return "microbatches_indivisible"
+        if zero > 0 and dp <= 1:
+            return "zero_requires_dp"   # zero stages shard over dp
+        return None
+
     def candidates(self, m: ModelSpec, n_chips: int,
                    global_batch: int) -> List[PlanCandidate]:
         out = []
@@ -323,8 +360,8 @@ class Planner:
         configs dropped)."""
         cands = [self.estimate(c, m, global_batch)
                  for c in self.candidates(m, n_chips, global_batch)]
-        # 0.95: the bench runs within ~5% of HBM (B8 OOMs, B4 fits)
-        feasible = [c for c in cands if c.est_mem_bytes <= 0.95 * self.hbm]
+        feasible = [c for c in cands
+                    if c.est_mem_bytes <= self.hbm_feasible_frac * self.hbm]
         if not feasible:
             raise RuntimeError(
                 f"planner: no feasible config for {m.n_params / 1e9:.1f}B "

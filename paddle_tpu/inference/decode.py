@@ -40,6 +40,7 @@ from jax import lax
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.core.dispatch import run_op
 from paddle_tpu.observability import metrics as _met
+from paddle_tpu.observability import server as _obs_server
 
 # Per-layer fixed-capacity cache. k/v: [B, C, num_kv_heads, head_dim];
 # length: [B] int32 — number of valid positions per sequence.
@@ -242,7 +243,37 @@ def _default_buckets(max_length):
     return out
 
 
-class DecodeSession:
+class _SessionLifecycle:
+    """Shared close()/context-manager/finalizer protocol for serving
+    sessions: one refcount on the PADDLE_TPU_METRICS_PORT scrape
+    endpoint, taken in __init__ (session_started) and released exactly
+    once here — the last session closing shuts the server down and
+    frees the port."""
+
+    def close(self):
+        """Release session-held resources. Idempotent; also runs via
+        the context-manager exit and the finalizer."""
+        if getattr(self, "_closed", False):
+            return
+        self._closed = True
+        if getattr(self, "_metrics_server", None) is not None:
+            self._metrics_server = None
+            _obs_server.session_finished()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class DecodeSession(_SessionLifecycle):
     """Compiled serving session over a causal-LM Layer.
 
     The model must implement ``init_cache(batch_size, max_length=C)`` ->
@@ -290,6 +321,10 @@ class DecodeSession:
             donate_argnums=tuple(range(n_state + 4,
                                        n_state + 4 + self._n_cache_leaves)))
         self._prefill_jit = jax.jit(self._prefill_pure)
+        # pull-based scrape endpoint (PADDLE_TPU_METRICS_PORT): hold
+        # one ref for this session's lifetime; close() releases it
+        self._metrics_server = _obs_server.session_started()
+        self._closed = False
 
     # -- state plumbing (same discipline as jit.StaticFunction) ---------
     def _collect_state(self):
@@ -500,6 +535,7 @@ class DecodeSession:
                 + self._decode_block_jit._cache_size())
 
 
+
 class _Request:
     __slots__ = ("rid", "ids", "plen", "budget", "tokens", "slot",
                  "t_submit")
@@ -512,7 +548,7 @@ class _Request:
         self.t_submit = time.perf_counter()
 
 
-class ContinuousBatchingSession:
+class ContinuousBatchingSession(_SessionLifecycle):
     """Continuous batching over the dense fixed-capacity cache: requests
     are admitted into free SLOTS and retired mid-flight while decode
     keeps running for the other slots.
@@ -609,6 +645,10 @@ class ContinuousBatchingSession:
             self._decode_blk_jit = jax.jit(
                 self._decode_block_pure,
                 donate_argnums=tuple(range(n + 3, n + 3 + nc)))
+        # pull-based scrape endpoint (PADDLE_TPU_METRICS_PORT): hold
+        # one ref for this session's lifetime; close() releases it
+        self._metrics_server = _obs_server.session_started()
+        self._closed = False
 
     # ---------------- compiled programs ------------------------------
     def _slot_slice(self, cache_arrays, slot):
@@ -892,6 +932,7 @@ class ContinuousBatchingSession:
         if self._decode_block:
             n_dec += self._decode_blk_jit._cache_size()
         return (self._admit_jit._cache_size(), n_dec)
+
 
 
 def cached_generate(model, input_ids, max_new_tokens=16, temperature=0.0,

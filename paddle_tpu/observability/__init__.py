@@ -29,6 +29,8 @@ import json as _json
 import time as _time
 
 from . import metrics as _metrics
+from . import catalog  # noqa: F401
+from . import server  # noqa: F401
 from . import training  # noqa: F401
 from .metrics import (  # noqa: F401
     REGISTRY, Counter, Gauge, Histogram, Registry,
@@ -37,6 +39,15 @@ from .metrics import (  # noqa: F401
 from .compile_tracker import (  # noqa: F401
     count_compiles, count_traces, install as _install_compile_hook,
 )
+from .server import MetricsServer  # noqa: F401
+from .snapshots import (  # noqa: F401
+    Snapshot, SnapshotDelta, delta, window,
+)
+
+
+def take_snapshot() -> Snapshot:
+    """Indexed read-side view of the live registry (snapshots.py)."""
+    return Snapshot.take()
 
 
 def counter(name, **labels):

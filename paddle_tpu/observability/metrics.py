@@ -54,6 +54,17 @@ def disable() -> None:
 
 _RESERVOIR_CAP = 512
 
+#: default cap on DISTINCT label-sets per metric name. A call site
+#: that (mistakenly) labels a metric with a per-request value — rid,
+#: prompt hash, timestamp — would otherwise grow the registry without
+#: bound over a long-lived serving session; past the cap, new
+#: label-sets get an unregistered throwaway metric and the
+#: ``metrics.dropped_series`` counter ticks instead.
+_MAX_SERIES_PER_NAME = 256
+
+#: overflow counter name (exempt from the cap; see catalog.py)
+_DROPPED_SERIES = "metrics.dropped_series"
+
 
 class Counter:
     """Monotonic float counter."""
@@ -177,26 +188,32 @@ class Histogram:
         return self._sum
 
     def percentile(self, q: float) -> Optional[float]:
-        """q in [0, 1] from the reservoir; None when empty."""
+        """q in [0, 1] from the reservoir; None when empty. Out-of-range
+        q clamps to the observed min/max (q<=0 -> min, q>=1 -> max)."""
         with self._lock:
             if not self._reservoir:
                 return None
             s = sorted(self._reservoir)
-        idx = min(int(q * len(s)), len(s) - 1)
+        idx = min(max(int(q * len(s)), 0), len(s) - 1)
         return s[idx]
 
     def _snapshot(self) -> dict:
         with self._lock:
-            if not self._count:
+            count, total = self._count, self._sum
+            if not count:
                 return {"count": 0, "sum": 0.0}
             s = sorted(self._reservoir)
-
-        def pct(q):
-            return s[min(int(q * len(s)), len(s) - 1)]
-        return {"count": self._count, "sum": self._sum,
-                "min": self._min, "max": self._max,
-                "mean": self._sum / self._count,
-                "p50": pct(0.50), "p90": pct(0.90), "p99": pct(0.99)}
+            mn, mx = self._min, self._max
+        out = {"count": count, "sum": total, "min": mn, "max": mx,
+               "mean": total / count}
+        if s:
+            # count >= 1 implies a non-empty reservoir today, but the
+            # percentile keys stay OPTIONAL in the export contract
+            # (to_prometheus / consumers already guard on presence)
+            def pct(q):
+                return s[min(max(int(q * len(s)), 0), len(s) - 1)]
+            out.update(p50=pct(0.50), p90=pct(0.90), p99=pct(0.99))
+        return out
 
     def _reset(self) -> None:
         self._count = 0
@@ -213,6 +230,15 @@ class Registry:
         self._metrics: Dict[tuple, object] = {}
         self._collectors: List[Callable[["Registry"], None]] = []
         self._lock = threading.RLock()
+        self._series_per_name: Dict[str, int] = {}
+        #: one shared detached sink per (name, kind) for over-cap
+        #: lookups — overflow stays O(1) memory AND allocation-free on
+        #: repeat lookups
+        self._overflow_sinks: Dict[tuple, object] = {}
+        #: distinct label-sets allowed per metric name; overflow is
+        #: dropped-and-counted (``metrics.dropped_series``) so a
+        #: per-request label can never OOM a long-lived session
+        self.max_series_per_name = _MAX_SERIES_PER_NAME
 
     # -- creation/lookup (cheap enough for warm paths; the hottest
     #    sites cache the returned object) ------------------------------
@@ -229,7 +255,22 @@ class Registry:
         with self._lock:
             m = self._metrics.get(key)
             if m is None:
+                if (name != _DROPPED_SERIES
+                        and self._series_per_name.get(name, 0)
+                        >= self.max_series_per_name):
+                    # cardinality overflow: hand back a shared DETACHED
+                    # sink (call site keeps working, nothing new is
+                    # retained) and count the dropped lookup — bounded
+                    # memory by design
+                    self._dropped_counter().inc()
+                    sink = self._overflow_sinks.get((name, cls.kind))
+                    if sink is None:
+                        sink = self._overflow_sinks[(name, cls.kind)] \
+                            = cls(name, lab)
+                    return sink
                 m = self._metrics[key] = cls(name, lab)
+                self._series_per_name[name] = \
+                    self._series_per_name.get(name, 0) + 1
             elif not isinstance(m, cls):
                 # a racing creator of another kind won: same contract
                 # as the fast path above
@@ -237,6 +278,16 @@ class Registry:
                     f"metric {name!r}{dict(lab)} already registered as "
                     f"{m.kind}, requested {cls.kind}")
             return m
+
+    def _dropped_counter(self) -> "Counter":
+        # direct registration, bypassing the cap check (call sites hold
+        # self._lock — it is an RLock)
+        key = (_DROPPED_SERIES, ())
+        m = self._metrics.get(key)
+        if m is None:
+            m = self._metrics[key] = Counter(_DROPPED_SERIES, ())
+            self._series_per_name[_DROPPED_SERIES] = 1
+        return m
 
     def counter(self, name: str, **labels) -> Counter:
         return self._get(Counter, name, labels)

@@ -1,0 +1,289 @@
+"""Telemetry-driven auto-tuning (ISSUE 13 tentpole): tune() scores
+candidates from metrics-registry deltas — no caller wall clock — plus
+the JSONL trial log's warm-start contract and the planner-refusal
+pruning. Everything here is pure host Python over the registry: no
+jax arrays, no devices, sub-second."""
+import json
+
+import pytest
+
+import paddle_tpu.observability as obs
+from paddle_tpu.distributed.auto_tuner import (
+    Candidate, default_score, generate_candidates, prune_by_planner,
+    tune)
+
+
+@pytest.fixture(autouse=True)
+def _metrics_on():
+    obs.enable()
+    yield
+    obs.enable()
+
+
+def _emit_steps(n_steps, step_s, tokens_per_step, mfu=None,
+                compiles=0):
+    """Simulate what the instrumented train loop writes per candidate
+    run (observability.training.record_step + the compile hook)."""
+    r = obs.REGISTRY
+    for _ in range(n_steps):
+        r.counter("train.steps").inc()
+        r.histogram("train.step_time_s").observe(step_s)
+        r.counter("train.tokens").inc(tokens_per_step)
+    if mfu is not None:
+        r.gauge("train.mfu").set(mfu)
+    if compiles:
+        r.counter("jit.xla_compiles").inc(compiles)
+
+
+# candidate key -> (step_s, mfu): dp4 is the clear winner
+PROFILES = {
+    (4, 1, 1): (0.10, 0.60),
+    (2, 1, 2): (0.15, 0.40),
+    (1, 1, 4): (0.30, 0.20),
+    (2, 2, 1): (0.20, 0.30),
+    (1, 2, 2): (0.25, 0.25),
+    (1, 4, 1): (0.40, 0.10),
+}
+
+
+def _run_candidate(c):
+    """Executes a fake candidate: moves the registry, returns None —
+    tune() must derive everything from the snapshot delta."""
+    step_s, mfu = PROFILES[(c.dp, c.pp, c.tp)]
+    _emit_steps(3, step_s, tokens_per_step=1024, mfu=mfu, compiles=2)
+    return None
+
+
+def _cands():
+    return [Candidate(dp=dp, pp=pp, tp=tp)
+            for (dp, pp, tp) in PROFILES]
+
+
+def test_tune_selects_best_from_registry_scores():
+    best = tune(_run_candidate, _cands(), verbose=False)
+    assert (best.dp, best.pp, best.tp) == (4, 1, 1)
+    # the score came from the registry, not a wall clock: the window's
+    # mfu gauge and tokens-per-step-second are recorded per candidate
+    assert best.score == pytest.approx(0.60)       # mfu primary signal
+    m = best.measurements
+    assert m["steps"] == 3
+    assert m["mean_step_s"] == pytest.approx(0.10)
+    assert m["tokens_per_s"] == pytest.approx(1024 / 0.10)
+    assert m["compiles"] == 2.0
+
+
+def test_recompile_penalty_orders_candidates():
+    # same MFU, but one config recompiles every step -> must lose
+    assert default_score({"mfu": 0.5, "compiles": 2}) > \
+        default_score({"mfu": 0.5, "compiles": 12})
+    # ladder: no mfu -> tokens/s; neither -> 1/step-time
+    assert default_score({"tokens_per_s": 100.0, "compiles": 0}) == \
+        pytest.approx(100.0)
+    assert default_score({"mean_step_s": 0.25}) == pytest.approx(4.0)
+    assert default_score({}) == 0.0
+
+
+def test_uniform_signal_rescoring_never_mixes_scales():
+    """Two candidates with IDENTICAL achieved MFU: the second one's
+    gauge write is invisible (value unchanged), so per-candidate
+    fallback would score it on tokens/s (thousands) against the
+    first's mfu (0..1) and hand it the win on a scale artifact. The
+    uniform rescoring drops mfu for BOTH and ranks on tokens/s."""
+    obs.REGISTRY.gauge("train.mfu").set(0.123)   # known pre-state
+    cands = [Candidate(dp=4, pp=1, tp=1),        # fast: 0.1 s/step
+             Candidate(dp=1, pp=1, tp=4)]        # slow: 0.4 s/step
+
+    def run_fn(c):
+        _emit_steps(2, 0.1 if c.dp == 4 else 0.4, 1024, mfu=0.45)
+
+    best = tune(run_fn, cands, verbose=False)
+    assert (best.dp, best.tp) == (4, 1)
+    # both candidates ended on the SAME signal (registry tokens/s)
+    assert cands[0].score == pytest.approx(1024 / 0.1)
+    assert cands[1].score == pytest.approx(1024 / 0.4)
+
+
+def test_trial_log_warm_start_skips_completed(tmp_path):
+    trials = str(tmp_path / "trials.jsonl")
+    runs = []
+
+    def run_fn(c):
+        runs.append(c.key)
+        if c.pp == 4:
+            raise RuntimeError("oom")        # failures are logged too
+        _emit_steps(2, 0.1 * c.tp + 0.05 * c.pp, 512)
+        return None
+
+    best1 = tune(run_fn, _cands(), verbose=False, trials_path=trials)
+    n_first = len(runs)
+    assert n_first == len(PROFILES)
+    recs = [json.loads(ln) for ln in open(trials)]
+    assert len(recs) == len(PROFILES)
+    assert any(r["error"] for r in recs)           # the oom trial
+    assert all("key" in r for r in recs)
+
+    # second run: every candidate (including the failed one) is
+    # satisfied from the log — run_fn never fires again
+    skipped0 = obs.counter("autotuner.trials_skipped").value
+    best2 = tune(run_fn, _cands(), verbose=False, trials_path=trials)
+    assert len(runs) == n_first
+    assert (best2.dp, best2.pp, best2.tp) == (best1.dp, best1.pp,
+                                              best1.tp)
+    assert best2.score == pytest.approx(best1.score)
+    assert obs.counter("autotuner.trials_skipped").value >= \
+        skipped0 + len(PROFILES)
+    # nothing new appended
+    assert len([json.loads(ln) for ln in open(trials)]) == len(PROFILES)
+
+    # a NEW candidate extends the log instead of restarting it
+    extra = Candidate(dp=8, pp=1, tp=1)
+    tune(run_fn, _cands() + [extra], verbose=False,
+         trials_path=trials)
+    assert len(runs) == n_first + 1 and runs[-1] == extra.key
+    assert len([json.loads(ln) for ln in open(trials)]) == \
+        len(PROFILES) + 1
+
+
+def test_trial_log_corrupt_tail_does_not_poison(tmp_path):
+    trials = tmp_path / "trials.jsonl"
+    trials.write_text(json.dumps(
+        {"key": "dp4_pp1_tp1_mb1_sp0_z0_r1", "score": 1e6}) +
+        "\n{truncated")
+    ran = []
+
+    def run_fn(c):
+        ran.append(c.key)
+        _emit_steps(1, 0.2, 128)
+
+    best = tune(run_fn, _cands(), verbose=False,
+                trials_path=str(trials))
+    # the intact line warm-starts (and wins with its recorded score);
+    # the corrupt tail is ignored, remaining candidates still run
+    assert best.key == "dp4_pp1_tp1_mb1_sp0_z0_r1"
+    assert best.score == pytest.approx(1e6)
+    assert len(ran) == len(PROFILES) - 1
+
+
+def test_pinned_source_never_reuses_other_mode_trials(tmp_path):
+    """Wallclock scores (1/s) and telemetry scores (mfu / tokens/s)
+    live on incomparable scales — a pinned-source sweep re-measures
+    rather than warm-starting from the other mode's log."""
+    trials = str(tmp_path / "t.jsonl")
+    tune(lambda c: 0.2 / c.dp, _cands(), verbose=False,
+         trials_path=trials, source="wallclock")
+    ran = []
+
+    def tele_run(c):
+        ran.append(c.key)
+        _emit_steps(1, 0.1, 256)
+
+    tune(tele_run, _cands(), verbose=False, trials_path=trials,
+         source="telemetry")
+    assert len(ran) == len(PROFILES)   # nothing reused across modes
+    # same mode: the telemetry records now warm-start (newest wins is
+    # not needed — _load_trials keeps the LAST record per key)
+    tune(tele_run, _cands(), verbose=False, trials_path=trials,
+         source="telemetry")
+    assert len(ran) == len(PROFILES)
+
+
+def test_mixed_mode_run_fn_aborts_loudly(tmp_path):
+    """A run_fn that switches scoring modes mid-sweep is a caller bug:
+    tune() ABORTS (either direction) instead of silently dropping the
+    mismatched candidates and crowning a winner from the survivors,
+    and no trial is logged for the mismatch."""
+    trials = str(tmp_path / "t.jsonl")
+    calls = []
+
+    def wall_then_tele(c):
+        calls.append(c)
+        if len(calls) == 1:
+            return 0.25            # resolves the sweep to wallclock
+        return None                # then switches mode
+
+    cands = [Candidate(dp=4, pp=1, tp=1), Candidate(dp=1, pp=1, tp=4)]
+    with pytest.raises(RuntimeError, match="mix scoring modes"):
+        tune(wall_then_tele, cands, verbose=False, trials_path=trials)
+    # only the clean first trial was persisted
+    assert len(open(trials).read().splitlines()) == 1
+
+    def tele_then_wall(c):
+        calls.append(c)
+        _emit_steps(1, 0.1, 64)
+        return 0.25 if len(calls) >= 4 else None
+
+    calls.clear()
+    with pytest.raises(RuntimeError, match="mix scoring modes"):
+        tune(tele_then_wall,
+             [Candidate(dp=4, pp=1, tp=1), Candidate(dp=2, pp=1, tp=2),
+              Candidate(dp=1, pp=1, tp=4), Candidate(dp=1, pp=2, tp=2)],
+             verbose=False)
+
+
+def test_wallclock_mode_backward_compatible():
+    cands = generate_candidates(8, num_layers=4, global_batch=16,
+                                num_heads=8)
+
+    def fake_run(c):
+        if c.tp == 8:
+            raise RuntimeError("oom")
+        return 1.0 / (c.dp + 0.5 * c.tp)
+
+    best = tune(fake_run, cands, verbose=False)
+    assert best.error is None and best.time_s is not None
+    # fastest feasible = max(dp + 0.5*tp) = dp8/tp1 -> 1/8.5 s
+    assert best.time_s == pytest.approx(1.0 / 8.5)
+    assert best.score == pytest.approx(8.5)
+
+
+def test_prune_by_planner_refuses_and_annotates():
+    from paddle_tpu.distributed.planner import ModelSpec
+    spec = ModelSpec.gpt(n_params=350e6, layers=24, hidden=1024,
+                         heads=16, seq=1024, vocab=50304)
+    cands = [Candidate(dp=4, pp=1, tp=1),          # fine
+             Candidate(dp=1, pp=1, tp=4),          # fine (16 % 4 == 0)
+             Candidate(dp=1, pp=7, tp=1, microbatches=8),  # 24 % 7
+             Candidate(dp=2, pp=1, tp=1),          # mesh mismatch (2 != 4)
+             Candidate(dp=1, pp=2, tp=2, microbatches=1),  # mb < pp
+             Candidate(dp=1, pp=1, tp=4, zero=2)]  # zero needs dp>1
+    kept = prune_by_planner(cands, spec, n_chips=4, global_batch=8)
+    kept_keys = {(c.dp, c.pp, c.tp) for c in kept}
+    assert kept_keys == {(4, 1, 1), (1, 1, 4)}
+    refused = [c for c in cands if c not in kept]
+    assert all(c.error and c.error.startswith("planner_refused")
+               for c in refused)
+    # survivors carry the planner's estimate for inspection
+    assert all(c.plan is not None and c.plan.est_step_s > 0
+               for c in kept)
+    # and tune(planner_spec=...) composes: refused configs never run
+    seen = []
+
+    def run_fn(c):
+        seen.append((c.dp, c.pp, c.tp))
+        return 1.0 / c.dp
+    best = tune(run_fn, list(cands), verbose=False,
+                planner_spec=(spec, 4, 8))
+    assert set(seen) == kept_keys
+    assert (best.dp, best.pp, best.tp) == (4, 1, 1)
+
+
+def test_planner_rules_lockstep():
+    """Planner.refusal_reason is the single home of the structural
+    legality rules: every config candidates() enumerates must pass it,
+    so the tuner's pruning can never drift from the planner's own
+    search space."""
+    from paddle_tpu.distributed.planner import ModelSpec, Planner
+    spec = ModelSpec.gpt(n_params=350e6, layers=24, hidden=1024,
+                         heads=16, seq=1024, vocab=50304)
+    pl = Planner("v5e")
+    cands = pl.candidates(spec, n_chips=8, global_batch=16)
+    assert cands
+    for p in cands:
+        reason = pl.refusal_reason(
+            spec, 8, 16, dp=p.dp, tp=p.tp, pp=p.pp,
+            microbatches=p.microbatches, zero=p.zero)
+        assert reason is None, (
+            f"candidates() proposed a config refusal_reason rejects "
+            f"({reason}): dp={p.dp} tp={p.tp} pp={p.pp} "
+            f"mb={p.microbatches} zero={p.zero} — the two rule sets "
+            "have drifted")

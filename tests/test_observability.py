@@ -121,7 +121,143 @@ def test_dump_writes_files(tmp_path):
     assert "paddle_tpu_t_dump_probe" in p_prom.read_text()
 
 
+# ----------------------------------------- histogram edge cases (ISSUE 13)
+def test_histogram_empty_and_single_observation():
+    h = obs.histogram("t.hist_edge_empty")
+    # empty reservoir: percentile -> None at every q, snapshot stays
+    # the minimal {count, sum} form (no percentile keys to lie with)
+    for q in (0.0, 0.5, 1.0):
+        assert h.percentile(q) is None
+    snap = h._snapshot()
+    assert snap == {"count": 0, "sum": 0.0}
+    # exporters agree at count=0: prometheus emits count/sum, no
+    # quantile lines for this series
+    text = obs.to_prometheus()
+    assert "paddle_tpu_t_hist_edge_empty_count 0" in text
+    assert 'paddle_tpu_t_hist_edge_empty{quantile' not in text
+
+    # single observation: every percentile IS that observation, and
+    # out-of-range q clamps instead of raising
+    h.observe(3.5)
+    for q in (-1.0, 0.0, 0.5, 0.99, 1.0, 2.0):
+        assert h.percentile(q) == 3.5
+    snap = h._snapshot()
+    assert snap["count"] == 1 and snap["min"] == snap["max"] == 3.5
+    assert snap["p50"] == snap["p90"] == snap["p99"] == 3.5
+    assert snap["mean"] == 3.5
+
+
+# ------------------------------------- label cardinality cap (ISSUE 13)
+def test_label_cardinality_cap_drops_and_counts():
+    reg = obs.REGISTRY
+    old_cap = reg.max_series_per_name
+    reg.max_series_per_name = 8
+    try:
+        dropped0 = obs.counter("metrics.dropped_series").value
+        made = [obs.counter("t.cap_probe", rid=str(i)) for i in range(20)]
+        for c in made:
+            c.inc()
+        # only the first 8 label-sets registered; the rest were
+        # detached throwaways (call sites keep working) and counted
+        series = [d for d in obs.dump() if d["name"] == "t.cap_probe"]
+        assert len(series) == 8
+        assert obs.counter("metrics.dropped_series").value == \
+            dropped0 + 12
+        # registered series are stable identities; overflow lookups
+        # share ONE detached sink per (name, kind) — no per-call
+        # allocation, still invisible to export
+        assert obs.counter("t.cap_probe", rid="0") is made[0]
+        over = obs.counter("t.cap_probe", rid="19")
+        assert over is made[19]            # the shared sink
+        assert over is not made[0]         # never a registered series
+        over.inc(5)   # works, goes nowhere
+        assert len([d for d in obs.dump()
+                    if d["name"] == "t.cap_probe"]) == 8
+        # the exempt overflow counter itself never drops
+        assert any(d["name"] == "metrics.dropped_series"
+                   for d in obs.dump())
+    finally:
+        reg.max_series_per_name = old_cap
+
+
+# --------------------------------------- snapshot read API (ISSUE 13)
+def test_snapshot_delta_window_and_rates():
+    obs.counter("t.read_ctr", k="a").inc(10)
+    obs.histogram("t.read_hist").observe(2.0)
+    obs.gauge("t.read_gauge").set(1.0)
+    before = obs.take_snapshot()
+    assert before.value("t.read_ctr", k="a") == 10.0
+    assert before.get("t.read_ctr", k="missing") is None
+    assert "t.read_hist" in before
+
+    obs.counter("t.read_ctr", k="a").inc(30)
+    obs.histogram("t.read_hist").observe(4.0)
+    obs.histogram("t.read_hist").observe(6.0)
+    obs.gauge("t.read_gauge").set(7.5)
+    after = obs.take_snapshot()
+
+    d = obs.delta(before, after)
+    assert d.value("t.read_ctr", k="a") == 30.0       # counter delta
+    assert d.value("t.read_gauge") == 7.5             # gauge end-state
+    h = d.hist("t.read_hist")                         # window stats
+    assert h["count"] == 2 and h["sum"] == 10.0 and h["mean"] == 5.0
+    # registry-only ratio: counter delta per histogram-sum second
+    assert d.per("t.read_ctr", "t.read_hist",
+                 labels={"k": "a"}) == pytest.approx(3.0)
+    # series that moved in the window, and only those
+    moved = {(c["name"], tuple(sorted(c["labels"].items())))
+             for c in d.changed()}
+    assert ("t.read_ctr", (("k", "a"),)) in moved
+    assert ("t.read_gauge", ()) in moved
+
+    with obs.window() as w:
+        obs.counter("t.read_ctr", k="a").inc(5)
+    assert w.value("t.read_ctr", k="a") == 5.0
+    assert w.delta.dt >= 0.0
+
+    # from_metrics round-trips a persisted snapshot (the BENCH
+    # telemetry blob path the perf gate reads)
+    blob = json.loads(json.dumps(after.metrics))
+    restored = obs.Snapshot.from_metrics(blob)
+    assert restored.value("t.read_ctr", k="a") == 40.0
+    d2 = obs.delta(before, restored)
+    assert d2.value("t.read_ctr", k="a") == 30.0
+
+
 # ------------------------------------------------------------- off switch
+def test_exporters_valid_when_disabled_mid_session():
+    """PADDLE_TPU_METRICS=off / disable() mid-session: the read side
+    must keep returning VALID (possibly frozen) output — a scrape or
+    dump racing a disable() can never crash a serving process."""
+    obs.counter("t.off_probe").inc(3)
+    h = obs.histogram("t.off_hist")
+    h.observe(1.0)
+    obs.disable()
+    try:
+        snap = obs.dump()
+        assert isinstance(snap, list) and snap
+        assert any(d["name"] == "t.off_probe" and d["value"] == 3.0
+                   for d in snap)
+        for ln in obs.to_jsonl().splitlines():
+            json.loads(ln)
+        text = obs.to_prometheus()
+        assert "paddle_tpu_t_off_probe 3" in text
+        assert text.endswith("\n")
+        # dump-to-file also stays valid
+        import tempfile
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "off.json")
+            obs.dump(p)
+            assert json.load(open(p))["metrics"]
+        # writes are inert while off; the frozen values persist
+        obs.counter("t.off_probe").inc(100)
+        h.observe(9.0)
+        assert obs.counter("t.off_probe").value == 3.0
+        assert h.count == 1
+    finally:
+        obs.enable()
+
+
 def test_disabled_is_noop_and_near_zero_cost():
     c = obs.counter("t.disabled_probe")
     h = obs.histogram("t.disabled_hist")
