@@ -375,11 +375,83 @@ def main():
             rungs["serve_cb_block16"] = {
                 "tokens_per_sec": round(done_new / cb_dt, 1),
                 "requests": 16, "slots": 8}
-            del cbs, lcm
+            del cbs
         except _SkipRung:
             pass
         except Exception as e:  # noqa: BLE001
             rungs["serve_cb_block16"] = {
+                "error": f"{type(e).__name__}: {e}"}
+
+        # adversarial overload rung (ISSUE 14): the same serving model
+        # under 2x-slot-capacity sustained offered load with a bounded
+        # queue — admission control sheds the excess with fast
+        # rejections while accepted requests keep flowing. Recorded as
+        # a within-window ratio vs the unthrottled cb rung (absolutes
+        # are transport weather), plus the accepted-request p99 from
+        # the registry histogram.
+        try:
+            if not _want("serve_overload_2x"):
+                raise _SkipRung()
+            import paddle_tpu as paddle
+            from paddle_tpu.inference.decode import (
+                AdmissionRejected, ContinuousBatchingSession)
+            if "lcm" not in locals():       # cb rung filtered out:
+                from paddle_tpu.models.llama import (LlamaConfig,
+                                                     LlamaForCausalLM)
+                paddle.seed(0)
+                lcm = LlamaForCausalLM(LlamaConfig(
+                    vocab_size=32000, hidden_size=2048,
+                    intermediate_size=5504, num_layers=24,
+                    num_heads=16, num_kv_heads=16, max_seq_len=512))
+                lcm.bfloat16()
+            ov = ContinuousBatchingSession(
+                lcm, max_slots=8, max_length=512, decode_block=16,
+                max_queue=8)
+            ov_rng = np.random.RandomState(1)
+            plens, submit_t, finish_t = {}, {}, {}
+            accepted = rejected = 0
+            t0 = time.perf_counter()
+            for _round in range(6):
+                for _ in range(16):         # 2x the 8 slots, per round
+                    pr = ov_rng.randint(0, 32000, (
+                        int(ov_rng.randint(32, 128)),)).astype(np.int32)
+                    bu = int(ov_rng.randint(64, 128))
+                    try:
+                        rid = ov.submit(pr, bu)
+                        plens[rid] = pr.size
+                        submit_t[rid] = time.perf_counter()
+                        accepted += 1
+                    except AdmissionRejected:
+                        rejected += 1
+                for rid in ov.step():
+                    finish_t[rid] = time.perf_counter()
+            # drain stepwise so completion times stay attributable to
+            # THIS window (the global latency histogram also holds the
+            # cb rung's samples)
+            while ov._queue or ov._running or ov._pending:
+                for rid in ov.step():
+                    finish_t[rid] = time.perf_counter()
+            ov_res = ov.results()
+            ov_dt = time.perf_counter() - t0
+            ov_gen = sum(len(r.ids) - plens[rid]
+                         for rid, r in ov_res.items())
+            hung = [rid for rid in plens if rid not in finish_t]
+            lats = sorted(finish_t[rid] - submit_t[rid]
+                          for rid in finish_t)
+            p99 = lats[min(int(0.99 * len(lats)), len(lats) - 1)] \
+                if lats else None
+            rungs["serve_overload_2x"] = {
+                "tokens_per_sec": round(ov_gen / ov_dt, 1),
+                "accepted": accepted, "rejected": rejected,
+                "hung": len(hung), "slots": 8, "max_queue": 8,
+                "p99_request_latency_s":
+                    round(p99, 4) if p99 is not None else None}
+            ov.close()
+            del ov, lcm
+        except _SkipRung:
+            pass
+        except Exception as e:  # noqa: BLE001
+            rungs["serve_overload_2x"] = {
                 "error": f"{type(e).__name__}: {e}"}
         _cleanup()
 
@@ -425,6 +497,14 @@ def main():
         if _cb.get("tokens_per_sec") and _dec.get("tokens_per_sec"):
             _cb["vs_decode_b8"] = round(
                 _cb["tokens_per_sec"] / _dec["tokens_per_sec"], 4)
+        # shed-not-collapse ratio: accepted throughput under 2x
+        # overload vs the unthrottled cb rung in the SAME window — the
+        # quantity the perf gate can pin (a collapsing session tends
+        # toward 0; a shedding one stays near 1)
+        _ov = rungs.get("serve_overload_2x") or {}
+        if _ov.get("tokens_per_sec") and _cb.get("tokens_per_sec"):
+            _ov["vs_cb_block16"] = round(
+                _ov["tokens_per_sec"] / _cb["tokens_per_sec"], 4)
 
     # A100@40%MFU proxy for this exact model (6*N + 12*L*H*S attention)
     flops_per_token = _gpt_flops_per_token(cfg, seq)

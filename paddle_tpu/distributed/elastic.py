@@ -23,15 +23,24 @@ def _wrap_ttl(value: str, ttl_s: Optional[float]) -> str:
 
 def _unwrap_ttl(raw) -> Optional[str]:
     """Decoded value, or None if malformed/expired."""
+    value, _expired = _decode_ttl(raw)
+    return value
+
+
+def _decode_ttl(raw):
+    """(value, expired): value is None when malformed OR expired;
+    expired is True only for a well-formed entry past its TTL — the
+    distinction lets FileKVStore physically purge expired files while
+    leaving foreign/malformed files alone."""
     try:
         payload = json.loads(raw)
     except (json.JSONDecodeError, UnicodeDecodeError, TypeError):
-        return None
+        return None, False
     if not isinstance(payload, dict) or "value" not in payload:
-        return None  # e.g. raw counters the store mirrors into kv space
+        return None, False  # e.g. raw counters mirrored into kv space
     if payload.get("expires") and payload["expires"] < time.time():
-        return None
-    return payload["value"]
+        return None, True
+    return payload["value"], False
 
 
 class KVStore:
@@ -74,9 +83,19 @@ class FileKVStore(KVStore):
                     raw = f.read()
             except OSError:
                 continue
-            value = _unwrap_ttl(raw)
+            value, expired = _decode_ttl(raw)
             if value is not None:
                 out[fn.replace("__", "/")] = value
+            elif expired:
+                # lazy GC: a long-running job heartbeats forever and
+                # would otherwise grow the store unboundedly with dead
+                # nodes' files. Racing a concurrent re-put is benign:
+                # worst case one fresh heartbeat file is dropped and
+                # the next heartbeat (heartbeat_s later) restores it.
+                try:
+                    os.remove(os.path.join(self.root, fn))
+                except OSError:
+                    pass
         return out
 
     def delete(self, key):

@@ -628,9 +628,15 @@ def load_int8_model(layer, path: str, compute_dtype="float32"):
 def __getattr__(name):
     # serving sessions live in .decode; export them lazily so importing
     # paddle_tpu.inference stays light (the decode module pulls model
-    # machinery)
+    # machinery). The robustness vocabulary (request states, admission
+    # exceptions) lives in .admission — stdlib-light, but exported the
+    # same way for one import surface.
     if name in ("DecodeSession", "ContinuousBatchingSession"):
         from . import decode
         return getattr(decode, name)
+    if name in ("RequestState", "RequestResult", "AdmissionRejected",
+                "ServingStepError", "AdmissionController"):
+        from . import admission
+        return getattr(admission, name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}")

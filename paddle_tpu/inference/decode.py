@@ -30,6 +30,7 @@ from __future__ import annotations
 import collections
 import math
 import time
+import weakref
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -41,6 +42,11 @@ from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.core.dispatch import run_op
 from paddle_tpu.observability import metrics as _met
 from paddle_tpu.observability import server as _obs_server
+from paddle_tpu import _chaos
+from paddle_tpu.inference import admission as _adm
+from paddle_tpu.inference.admission import (AdmissionRejected,  # noqa: F401
+                                            RequestResult, RequestState,
+                                            ServingStepError)
 
 # Per-layer fixed-capacity cache. k/v: [B, C, num_kv_heads, head_dim];
 # length: [B] int32 — number of valid positions per sequence.
@@ -50,6 +56,8 @@ StaticCache = collections.namedtuple("StaticCache", ["k", "v", "length"])
 def init_static_cache(batch_size, capacity, num_kv_heads, head_dim,
                       dtype="float32"):
     """Allocate one layer's fixed-capacity KV cache."""
+    _chaos.hit("serving.cache_alloc", batch=batch_size,
+               capacity=capacity)
     from paddle_tpu.ops.creation import zeros
     k = zeros([batch_size, capacity, num_kv_heads, head_dim], dtype=dtype)
     v = zeros([batch_size, capacity, num_kv_heads, head_dim], dtype=dtype)
@@ -538,14 +546,32 @@ class DecodeSession(_SessionLifecycle):
 
 class _Request:
     __slots__ = ("rid", "ids", "plen", "budget", "tokens", "slot",
-                 "t_submit")
+                 "t_submit", "state", "priority", "deadline",
+                 "ttft_deadline", "error")
 
-    def __init__(self, rid, ids, plen, budget):
+    def __init__(self, rid, ids, plen, budget, priority=0,
+                 deadline_s=None, ttft_deadline_s=None):
         self.rid, self.ids, self.plen = rid, ids, plen
         self.budget = budget
         self.tokens: List[int] = []
         self.slot = None
         self.t_submit = time.perf_counter()
+        self.state = RequestState.QUEUED
+        self.priority = int(priority)
+        # deadlines are absolute perf_counter instants; None = no bound
+        self.deadline = (self.t_submit + deadline_s
+                         if deadline_s is not None else None)
+        self.ttft_deadline = (self.t_submit + ttft_deadline_s
+                              if ttft_deadline_s is not None else None)
+        self.error = None
+
+    def deadline_hit(self, now):
+        """Total deadline always applies; the TTFT deadline only until
+        the first token has been DELIVERED (drained to the host)."""
+        if self.deadline is not None and now > self.deadline:
+            return True
+        return (self.ttft_deadline is not None and not self.tokens
+                and now > self.ttft_deadline)
 
 
 class ContinuousBatchingSession(_SessionLifecycle):
@@ -580,7 +606,11 @@ class ContinuousBatchingSession(_SessionLifecycle):
     def __init__(self, model, max_slots, max_length,
                  prefill_buckets=None, temperature=0.0, top_p=None,
                  top_k=None, eos_token_id=None, seed=0,
-                 sync_every=1, decode_block=None):
+                 sync_every=1, decode_block=None,
+                 max_queue=None, shed_policy="reject_newest",
+                 default_deadline_s=None, default_ttft_s=None,
+                 step_retries=2, step_backoff_s=0.02,
+                 degraded_queue_frac=0.8):
         model.eval()
         self._model = model
         self._slots = int(max_slots)
@@ -645,6 +675,29 @@ class ContinuousBatchingSession(_SessionLifecycle):
             self._decode_blk_jit = jax.jit(
                 self._decode_block_pure,
                 donate_argnums=tuple(range(n + 3, n + 3 + nc)))
+        # robustness knobs (ISSUE 14): bounded-queue admission control
+        # with a pluggable shedding policy, per-request deadline
+        # defaults, and the device-step retry envelope
+        self._admission = _adm.AdmissionController(
+            max_queue=max_queue, policy=shed_policy,
+            degraded_queue_frac=degraded_queue_frac)
+        self._default_deadline_s = default_deadline_s
+        self._default_ttft_s = default_ttft_s
+        self._step_retries = max(0, int(step_retries))
+        self._step_backoff_s = float(step_backoff_s)
+        # readiness: /healthz flips to 503 `degraded` while this
+        # session reports queue/slot pressure, so load balancers route
+        # away BEFORE the shedding policy has to reject. Registered
+        # through a weakref so the module-global provider list never
+        # pins an abandoned session alive (close()'s finalizer path
+        # must stay reachable).
+        wself = weakref.ref(self)
+
+        def _provider():
+            s = wself()
+            return s._health_report() if s is not None else None
+        self._health_unreg = _obs_server.register_health_provider(
+            _provider)
         # pull-based scrape endpoint (PADDLE_TPU_METRICS_PORT): hold
         # one ref for this session's lifetime; close() releases it
         self._metrics_server = _obs_server.session_started()
@@ -746,8 +799,18 @@ class ContinuousBatchingSession(_SessionLifecycle):
                                  cache_arrays)
 
     # ---------------- host-side slot management ----------------------
-    def submit(self, input_ids, max_new_tokens, request_id=None):
-        """Queue one request (1D token list/array). Returns its id."""
+    def submit(self, input_ids, max_new_tokens, request_id=None,
+               priority=0, deadline_s=None, ttft_deadline_s=None):
+        """Queue one request (1D token list/array). Returns its id.
+
+        deadline_s / ttft_deadline_s bound the request's TOTAL and
+        time-to-first-token wall time (defaults from the session);
+        expiry evicts the request with state TIMED_OUT instead of
+        letting it wait forever. With a bounded queue (``max_queue``)
+        an overloaded session sheds load: the configured policy either
+        raises :class:`AdmissionRejected` here (fast rejection — the
+        request never waits) or, under the ``priority`` policy, evicts
+        a lower-priority queued request (delivered as REJECTED)."""
         ids = np.asarray(
             input_ids._data if isinstance(input_ids, Tensor)
             else input_ids).reshape(-1).astype(np.int32)
@@ -765,9 +828,25 @@ class ContinuousBatchingSession(_SessionLifecycle):
                 self._next_rid += 1
             rid = self._next_rid
             self._next_rid += 1
+        req = _Request(
+            rid, ids, ids.size, max_new_tokens, priority=priority,
+            deadline_s=(deadline_s if deadline_s is not None
+                        else self._default_deadline_s),
+            ttft_deadline_s=(ttft_deadline_s if ttft_deadline_s
+                             is not None else self._default_ttft_s))
+        try:
+            victim = self._admission.admit(self._queue, req,
+                                           free_slots=len(self._free))
+        except AdmissionRejected:
+            # shed-not-collapse: the rejection is the fast path — no
+            # rid is consumed, nothing is retained
+            if _met._ENABLED:
+                _met.REGISTRY.counter("serving.rejected").inc()
+            raise
         self._used_rids.add(rid)
-        self._queue.append(_Request(rid, ids, ids.size,
-                                    max_new_tokens))
+        if victim is not None:
+            self._finish(victim, RequestState.REJECTED)
+        self._queue.append(req)
         if _met._ENABLED:
             r = _met.REGISTRY
             r.counter("serving.requests_submitted").inc()
@@ -776,22 +855,219 @@ class ContinuousBatchingSession(_SessionLifecycle):
                 len(self._used_rids))
         return rid
 
+    def cancel(self, request_id):
+        """Cancel a queued or running request: it transitions to
+        CANCELLED, its slot (if any) is freed for the next admission,
+        and its partial output is delivered with the next drain.
+        Returns True if the request was found in a non-terminal state
+        (unknown / already-terminal ids return False)."""
+        for req in self._queue:
+            if req.rid == request_id:
+                self._queue.remove(req)
+                self._finish(req, RequestState.CANCELLED)
+                return True
+        for req in list(self._running.values()):
+            if req.rid == request_id:
+                self._finish(req, RequestState.CANCELLED)
+                return True
+        return False
+
+    def status(self, request_id):
+        """RequestState of an in-flight or undelivered request; None
+        for unknown (or already-delivered) ids."""
+        for req in self._queue:
+            if req.rid == request_id:
+                return req.state
+        for req in self._running.values():
+            if req.rid == request_id:
+                return req.state
+        req = self._done.get(request_id)
+        return req.state if req is not None else None
+
+    # -------- lifecycle internals (state machine + recovery) ---------
+    def _finish(self, req, state, error=None):
+        """The single terminal transition: free the slot, record the
+        state, park the request for delivery, tick the outcome
+        counter. Every exit path — retire, timeout, cancel, shed,
+        quarantine — funnels through here."""
+        if req.slot is not None:
+            self._running.pop(req.slot, None)
+            self._free.append(req.slot)
+            req.slot = None
+        req.state = state
+        req.error = error
+        self._done[req.rid] = req
+        if _met._ENABLED:
+            r = _met.REGISTRY
+            if state is RequestState.DONE:
+                r.counter("serving.requests_completed").inc()
+                r.histogram("serving.request_latency_s").observe(
+                    time.perf_counter() - req.t_submit)
+            elif state is RequestState.TIMED_OUT:
+                r.counter("serving.timed_out").inc()
+            elif state is RequestState.CANCELLED:
+                r.counter("serving.cancelled").inc()
+            elif state is RequestState.REJECTED:
+                r.counter("serving.rejected").inc()
+            elif state is RequestState.FAILED:
+                r.counter("serving.quarantined").inc()
+
+    def _expire_deadlines(self):
+        """Evict deadline-exceeded requests (queued AND running) —
+        runs at the top of every step, so expiry is honored within one
+        step of the deadline instant."""
+        if not (self._queue or self._running):
+            return
+        now = time.perf_counter()
+        for req in [r for r in self._queue if r.deadline_hit(now)]:
+            self._queue.remove(req)
+            self._finish(req, RequestState.TIMED_OUT)
+        for req in list(self._running.values()):
+            if req.deadline_hit(now):
+                self._finish(req, RequestState.TIMED_OUT)
+
+    def _health_report(self):
+        """Readiness provider for the /healthz endpoint: a non-empty
+        reason list means degraded (503)."""
+        if getattr(self, "_closed", False):
+            return None
+        return self._admission.degraded_reasons(
+            len(self._queue), len(self._free))
+
+    def _device_call(self, site, ctx, fn, retries=None):
+        """Retry-with-backoff envelope around one device dispatch.
+        The chaos hook sits INSIDE the try so injected faults exercise
+        the same recovery as real ones. Retrying is safe here because
+        a dispatch that raised did not consume its donated buffers —
+        the session state the closure captured is still alive."""
+        retries = self._step_retries if retries is None else retries
+        delay = self._step_backoff_s
+        attempt = 0
+        while True:
+            try:
+                _chaos.hit(site, **ctx)
+                return fn()
+            except Exception:
+                if attempt >= retries:
+                    raise
+                attempt += 1
+                if _met._ENABLED:
+                    _met.REGISTRY.counter("serving.step_retries").inc()
+                if delay > 0:
+                    time.sleep(delay)
+                    delay *= 2
+
+    def _dispatch_once(self, state, slots, retries=None):
+        """One decode dispatch for the given active-slot subset; on
+        success the sampled tokens are committed to pending tagged
+        with exactly that subset (drains credit only those slots)."""
+        active = np.zeros((self._slots,), bool)
+        active[list(slots)] = True
+
+        def call():
+            if self._decode_block:
+                return self._decode_blk_jit(
+                    *state, self._tokens, self._key,
+                    jnp.asarray(active), *self._cache_arrays)
+            return self._decode_jit(
+                *state, self._tokens, self._key, jnp.asarray(active),
+                *self._cache_arrays)
+
+        out = self._device_call("serving.decode_step",
+                                {"slots": slots}, call, retries)
+        if self._decode_block:
+            blk_out, self._tokens, self._key, self._cache_arrays = out
+            self._pending.append(("block", slots, blk_out))
+        else:
+            self._tokens, self._key, self._cache_arrays = out
+            self._pending.append(("step", slots, self._tokens))
+
+    def _probe_slots(self, state, subset):
+        """Single-attempt step over a slot subset. A SUCCESSFUL probe
+        is a real step — its tokens are committed and delivered — so
+        bisection never wastes device work or skips tokens. Returns
+        True when the subset still fails."""
+        try:
+            self._dispatch_once(state, tuple(subset), retries=0)
+            return False
+        except Exception:
+            return True
+
+    def _bisect_poison(self, state, slots, exc):
+        """Find the single poison slot by probing halves. Returns the
+        slot, or None when every probe succeeded (the fault cleared —
+        all slots stepped during recovery). Raises ServingStepError
+        when DISJOINT subsets fail: that is a step-wide fault, not a
+        poison request, and pretending otherwise would quarantine
+        innocent requests one by one."""
+        while len(slots) > 1:
+            mid = len(slots) // 2
+            left, right = slots[:mid], slots[mid:]
+            lf = self._probe_slots(state, left)
+            rf = self._probe_slots(state, right)
+            if lf and rf:
+                raise ServingStepError(
+                    "decode step fails for disjoint slot subsets "
+                    f"{tuple(left)} and {tuple(right)} — failure is "
+                    "step-wide, not attributable to one poison "
+                    "request") from exc
+            if lf:
+                slots = left
+            elif rf:
+                slots = right
+            else:
+                return None
+        return slots[0]
+
+    def _recover_decode(self, state, slots, exc):
+        """Persistent step failure (retry budget exhausted): isolate
+        the poison request by bisection and fail ONLY it; the session
+        and every other in-flight request stay alive. The freed slot
+        returns to the pool (its cache region is reset by the next
+        admission's prefill)."""
+        if len(slots) == 1:
+            poison = slots[0]
+        else:
+            poison = self._bisect_poison(state, list(slots), exc)
+            if poison is None:
+                return
+        req = self._running.get(poison)
+        if req is not None:
+            self._finish(req, RequestState.FAILED,
+                         error=f"{type(exc).__name__}: {exc}")
+
     def _admit_ready(self):
         state = [t._data for t in self._state_t]
         t_admit = time.perf_counter()
         while self._free and self._queue:
             req = self._queue.popleft()
             slot = self._free.pop()
+            req.state = RequestState.PREFILLING
             bucket = next((b for b in self._buckets
                            if b >= req.plen), self._max_length)
             padded = jnp.asarray(
                 np.pad(req.ids, (0, bucket - req.plen))[None])
-            self._tokens, self._key, self._cache_arrays = \
-                self._admit_jit(*state, padded,
-                                jnp.int32(req.plen), jnp.int32(slot),
-                                self._tokens, self._key,
-                                *self._cache_arrays)
+
+            def call():
+                return self._admit_jit(
+                    *state, padded, jnp.int32(req.plen),
+                    jnp.int32(slot), self._tokens, self._key,
+                    *self._cache_arrays)
+
+            try:
+                self._tokens, self._key, self._cache_arrays = \
+                    self._device_call("serving.admit_step",
+                                      {"rid": req.rid, "slot": slot},
+                                      call)
+            except Exception as e:  # noqa: BLE001
+                # the failing request is identified directly here (the
+                # admit is b=1): quarantine it, keep admitting others
+                self._free.append(slot)
+                self._finish(req, RequestState.FAILED,
+                             error=f"{type(e).__name__}: {e}")
+                continue
             req.slot = slot
+            req.state = RequestState.DECODING
             self._running[slot] = req
             if _met._ENABLED:
                 r = _met.REGISTRY
@@ -819,43 +1095,45 @@ class ContinuousBatchingSession(_SessionLifecycle):
                 or (self._eos is not None
                     and req.tokens
                     and req.tokens[-1] == self._eos)):
-            self._running.pop(req.slot, None)
-            self._free.append(req.slot)
-            req.slot = None
-            self._done[req.rid] = req
-            if _met._ENABLED:
-                r = _met.REGISTRY
-                r.counter("serving.requests_completed").inc()
-                r.histogram("serving.request_latency_s").observe(
-                    time.perf_counter() - req.t_submit)
+            self._finish(req, RequestState.DONE)
 
     def _drain_pending(self):
         if not self._pending:
             return
         entries = self._pending
         self._pending = []
+        _chaos.hit("serving.drain", n=len(entries))
         fetched = jax.device_get([t for (_k, _s, t) in entries])
         delivered = 0
-        for (kind, aslot, _t), row in zip(entries, fetched):
+        for (kind, ainfo, _t), row in zip(entries, fetched):
+            # ainfo: the admitted slot ("admit") or the tuple of slots
+            # active AT DISPATCH ("step"/"block") — only those lanes
+            # carry live tokens; slots evicted (cancel/timeout/
+            # quarantine) between dispatch and drain are skipped, and
+            # recovery probes over subsets credit exactly their subset
             row = np.asarray(row)
             if kind == "admit":
-                req = self._running.get(aslot)
+                req = self._running.get(ainfo)
                 if req is not None:
-                    req.tokens.append(int(row[aslot]))
+                    req.tokens.append(int(row[ainfo]))
                     delivered += 1
                     self._maybe_retire(req)
                 continue
             if kind == "block":
                 for col in range(row.shape[1]):
-                    for slot, req in list(self._running.items()):
-                        req.tokens.append(int(row[slot, col]))
-                        delivered += 1
-                        self._maybe_retire(req)
+                    for slot in ainfo:
+                        req = self._running.get(slot)
+                        if req is not None:
+                            req.tokens.append(int(row[slot, col]))
+                            delivered += 1
+                            self._maybe_retire(req)
                 continue
-            for slot, req in list(self._running.items()):
-                req.tokens.append(int(row[slot]))
-                delivered += 1
-                self._maybe_retire(req)
+            for slot in ainfo:
+                req = self._running.get(slot)
+                if req is not None:
+                    req.tokens.append(int(row[slot]))
+                    delivered += 1
+                    self._maybe_retire(req)
         if _met._ENABLED and delivered:
             now = time.perf_counter()
             r = _met.REGISTRY
@@ -866,11 +1144,13 @@ class ContinuousBatchingSession(_SessionLifecycle):
             self._t_last_drain = now
 
     def step(self):
-        """Admit whatever fits (on sync boundaries), run ONE batched
-        decode step, and — every `sync_every` steps — fetch the pending
-        token block and retire finished requests. Returns the list of
-        request ids completed during this step."""
+        """Expire deadlines, admit whatever fits (on sync boundaries),
+        run ONE batched decode step under the retry/recovery envelope,
+        and — every `sync_every` steps — fetch the pending token block
+        and retire finished requests. Returns the list of request ids
+        that reached a terminal state during this step."""
         before = set(self._done)
+        self._expire_deadlines()
         if not self._pending:
             self._admit_ready()
         if _met._ENABLED:
@@ -880,39 +1160,40 @@ class ContinuousBatchingSession(_SessionLifecycle):
             r.gauge("serving.slots_active").set(len(self._running))
             r.gauge("serving.slot_utilization").set(
                 len(self._running) / self._slots)
+            r.gauge("serving.degraded").set(
+                1.0 if self._health_report() else 0.0)
         if self._running:
             state = [t._data for t in self._state_t]
-            active = np.zeros((self._slots,), bool)
-            active[list(self._running)] = True
-            if self._decode_block:
-                blk_out, self._tokens, self._key, self._cache_arrays = \
-                    self._decode_blk_jit(*state, self._tokens,
-                                         self._key,
-                                         jnp.asarray(active),
-                                         *self._cache_arrays)
-                self._pending.append(("block", None, blk_out))
-            else:
-                self._tokens, self._key, self._cache_arrays = \
-                    self._decode_jit(*state, self._tokens, self._key,
-                                     jnp.asarray(active),
-                                     *self._cache_arrays)
-                self._pending.append(("step", None, self._tokens))
-        if len(self._pending) >= self._sync_every:
+            slots = tuple(sorted(self._running))
+            try:
+                self._dispatch_once(state, slots)
+            except ServingStepError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                self._recover_decode(state, slots, e)
+        if len(self._pending) >= self._sync_every or (
+                self._pending and not self._running):
+            # the second arm flushes a PARTIAL sync window when no slot
+            # is decoding anymore (every running request was cancelled/
+            # timed out/quarantined mid-window): admission is gated on
+            # an empty pending list, so waiting out the quantum would
+            # deadlock step()/results() with work still queued
             self._drain_pending()
         return [r for r in self._done if r not in before]
 
-    def run(self):
-        """Drain queue + running slots; returns {rid: full token ids}
-        (prompt + generated, eos included when emitted) for requests
-        completed by THIS drain (or still undelivered from step()
-        calls). Delivered results are released — a later run() never
-        re-delivers them, their request_ids become reusable, and
-        neither _done nor _used_rids grows unboundedly in a long-lived
-        serving session."""
+    def results(self):
+        """Drive the session until every submitted request reaches a
+        terminal state, then deliver {rid: RequestResult} — terminal
+        state, prompt + generated ids (partial for TIMED_OUT /
+        CANCELLED / FAILED), and the error string for FAILED.
+        Delivered results are released exactly like :meth:`run`."""
         while self._queue or self._running or self._pending:
             self.step()
-        out = {rid: np.concatenate([req.ids,
-                                    np.asarray(req.tokens, np.int32)])
+        out = {rid: RequestResult(
+                   req.state,
+                   np.concatenate([req.ids,
+                                   np.asarray(req.tokens, np.int32)]),
+                   req.error)
                for rid, req in self._done.items()}
         self._done = {}
         # delivered ids leave the in-flight set: a serving loop calling
@@ -922,6 +1203,42 @@ class ContinuousBatchingSession(_SessionLifecycle):
             _met.REGISTRY.gauge("serving.inflight_requests").set(
                 len(self._used_rids))
         return out
+
+    def run(self):
+        """Drain queue + running slots; returns {rid: full token ids}
+        (prompt + generated, eos included when emitted) for requests
+        completed by THIS drain (or still undelivered from step()
+        calls). Requests that ended TIMED_OUT / CANCELLED / FAILED /
+        REJECTED deliver their partial ids here — use :meth:`results`
+        for the terminal states. Delivered results are released — a
+        later run() never re-delivers them, their request_ids become
+        reusable, and neither _done nor _used_rids grows unboundedly
+        in a long-lived serving session."""
+        return {rid: res.ids for rid, res in self.results().items()}
+
+    def close(self):
+        """Cancel in-flight work, then release shared resources.
+        Queued and running requests transition to CANCELLED (their
+        pending device futures are dropped — nothing waits on the
+        device, so close never hangs), undelivered results are
+        discarded, and ``_used_rids`` ends empty. Idempotent; also
+        runs via the context-manager exit and the finalizer."""
+        if getattr(self, "_closed", False):
+            return
+        for req in list(getattr(self, "_queue", ())):
+            self._finish(req, RequestState.CANCELLED)
+        if getattr(self, "_queue", None) is not None:
+            self._queue.clear()
+        for req in list(getattr(self, "_running", {}).values()):
+            self._finish(req, RequestState.CANCELLED)
+        self._pending = []
+        self._done = {}
+        if getattr(self, "_used_rids", None) is not None:
+            self._used_rids.clear()
+        if getattr(self, "_health_unreg", None) is not None:
+            self._health_unreg()
+            self._health_unreg = None
+        super().close()
 
     def executable_counts(self):
         """(n_admit_executables, n_decode_executables): admit is

@@ -83,6 +83,22 @@ CATALOG = {
     "serving.slot_utilization": _m("gauge", "active slots / max slots"),
     "serving.inflight_requests": _m(
         "gauge", "submitted-but-undelivered requests"),
+    # -------------------------------------- serving robustness (ISSUE 14)
+    "serving.rejected": _m(
+        "counter", "requests shed by admission control (fast "
+        "rejections + priority-lane evictions)"),
+    "serving.timed_out": _m(
+        "counter", "requests evicted at a TTFT/total deadline"),
+    "serving.cancelled": _m(
+        "counter", "requests cancelled by the caller or session close"),
+    "serving.step_retries": _m(
+        "counter", "device-step retries inside the backoff envelope"),
+    "serving.quarantined": _m(
+        "counter", "poison requests failed+isolated by step-failure "
+        "recovery (admit-time or bisection)"),
+    "serving.degraded": _m(
+        "gauge", "1 while readiness reports degraded "
+        "(queue/slot pressure past thresholds)"),
     # ----------------------------------------------------- dataloader
     "dataloader.fetch_wait_s": _m(
         "histogram", "time the consumer waited on the loader"),

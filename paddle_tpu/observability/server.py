@@ -5,7 +5,13 @@ A stdlib-only ``http.server`` serving two routes:
   * ``GET /metrics``  -> ``REGISTRY.to_prometheus()`` (text exposition
     format 0.0.4), rendered at request time so every scrape sees the
     live registry (collectors included);
-  * ``GET /healthz``  -> ``{"status": "ok"}`` liveness probe.
+  * ``GET /healthz``  -> readiness probe: ``200 {"status": "ok"}``
+    while every registered health provider is content, ``503
+    {"status": "degraded", "reasons": [...]}`` when any reports
+    pressure (serving sessions register queue-depth / slot-pressure
+    providers via :func:`register_health_provider`), so load
+    balancers route away from an overloaded process BEFORE its
+    admission control has to shed.
 
 Lifecycle is REFERENCE-COUNTED and owned by the serving sessions
 (``inference.decode.DecodeSession`` / ``ContinuousBatchingSession``):
@@ -54,8 +60,9 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             body = _met.REGISTRY.to_prometheus().encode("utf-8")
             self._reply(200, _CONTENT_TYPE, body)
         elif path == "/healthz":
-            body = json.dumps({"status": "ok"}).encode("utf-8")
-            self._reply(200, "application/json", body)
+            ok, payload = health_status()
+            body = json.dumps(payload).encode("utf-8")
+            self._reply(200 if ok else 503, "application/json", body)
         else:
             self._reply(404, "text/plain; charset=utf-8",
                         b"not found: try /metrics or /healthz\n")
@@ -107,6 +114,48 @@ class MetricsServer:
     def url(self) -> str:
         host = "127.0.0.1" if self.host in ("0.0.0.0", "") else self.host
         return f"http://{host}:{self.port}"
+
+
+# ---------------------------------------------------------------------
+# readiness providers: callables returning a (possibly empty) list of
+# degradation reasons — or None/[] while healthy. Serving sessions
+# register one for their queue/slot pressure; /healthz aggregates.
+_health_lock = threading.Lock()
+_health_providers: list = []
+
+
+def register_health_provider(fn):
+    """Register a readiness provider; returns its unregister callable
+    (idempotent). A provider that raises is skipped for that probe —
+    a broken provider must never flap readiness on its own."""
+    with _health_lock:
+        _health_providers.append(fn)
+
+    def _unregister():
+        with _health_lock:
+            try:
+                _health_providers.remove(fn)
+            except ValueError:
+                pass
+    return _unregister
+
+
+def health_status():
+    """Aggregate readiness: ``(True, {"status": "ok"})`` or
+    ``(False, {"status": "degraded", "reasons": [...]})``."""
+    with _health_lock:
+        providers = list(_health_providers)
+    reasons = []
+    for fn in providers:
+        try:
+            r = fn()
+        except Exception:
+            continue
+        if r:
+            reasons.extend(r if isinstance(r, (list, tuple)) else [r])
+    if reasons:
+        return False, {"status": "degraded", "reasons": reasons}
+    return True, {"status": "ok"}
 
 
 # ---------------------------------------------------------------------

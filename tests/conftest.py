@@ -61,6 +61,19 @@ def _seeded():
     yield
 
 
+@pytest.fixture(autouse=True)
+def _chaos_hygiene(request):
+    """Fault-injection hygiene for `chaos`-marked tests (pytest.ini):
+    installed rules and the arming env var never leak into later
+    tests — a leaked persistent rule would fail every serving test
+    after it."""
+    yield
+    if request.node.get_closest_marker("chaos") is not None:
+        from paddle_tpu import _chaos
+        _chaos.clear()
+        os.environ.pop(_chaos.ENV, None)
+
+
 # one log per session (pid-suffixed: concurrent sessions/users must not
 # clobber each other's 'first leaker' diagnostic or hit foreign-owned
 # /tmp files in fixture teardown)

@@ -47,6 +47,61 @@ def test_async_checkpoint(tmp_path):
     np.testing.assert_allclose(out["a"].numpy(), [1, 2])
 
 
+def test_commit_marker_orders_the_save(tmp_path):
+    """ISSUE 14 satellite: save publishes `_COMMITTED.json` LAST;
+    load refuses a checkpoint without it (a save interrupted between
+    shard and metadata writes is indistinguishable from a valid one
+    by per-file inspection) unless require_committed=False."""
+    from paddle_tpu.distributed import checkpoint as dc
+    ck = tmp_path / "ck"
+    sd = {"a": paddle.to_tensor([3.0, 4.0]), "step": 5}
+    dc.save_state_dict(sd, str(ck))
+    assert (ck / dc.COMMIT_MARKER).exists()
+    assert dc.is_committed(str(ck))
+
+    # an uncommitted (interrupted) save is refused with a clear error
+    os.remove(ck / dc.COMMIT_MARKER)
+    out = {"a": paddle.zeros([2]), "step": 0}
+    with pytest.raises(ValueError, match="not committed"):
+        dc.load_state_dict(out, str(ck))
+    # legacy escape hatch still loads it
+    dc.load_state_dict(out, str(ck), require_committed=False)
+    np.testing.assert_allclose(out["a"].numpy(), [3, 4])
+
+    # a TORN save (marker present, referenced shard missing) is
+    # refused too — this is the read-side ordering guarantee
+    dc.save_state_dict(sd, str(ck))
+    os.remove(ck / "shard_0.npz")
+    assert not dc.is_committed(str(ck))
+    with pytest.raises(ValueError, match="partial"):
+        dc.load_state_dict(out, str(ck))
+
+
+def test_latest_committed_skips_in_progress_saves(tmp_path):
+    """Elastic resume picks the NEWEST committed per-step directory,
+    ignoring a newer save that never committed (killed mid-write)."""
+    from paddle_tpu.distributed import checkpoint as dc
+    root = tmp_path / "ckpts"
+    root.mkdir()
+    assert dc.latest_committed(str(root)) is None
+    for step in (0, 1, 2):
+        dc.save_state_dict({"a": paddle.to_tensor([float(step)]),
+                            "step": step},
+                           str(root / f"step_{step:04d}"))
+    # step 3 "crashed" after writing its shard but before the marker
+    dc.save_state_dict({"a": paddle.to_tensor([3.0]), "step": 3},
+                       str(root / "step_0003"))
+    os.remove(root / "step_0003" / dc.COMMIT_MARKER)
+    latest = dc.latest_committed(str(root))
+    assert latest is not None and latest.endswith("step_0002"), latest
+    out = {"a": paddle.zeros([1]), "step": -1}
+    dc.load_state_dict(out, latest)
+    assert out["step"] == 2
+    # a root that is itself a committed checkpoint returns itself
+    dc.save_state_dict({"a": paddle.to_tensor([9.0])}, str(root))
+    assert dc.latest_committed(str(root)) == str(root)
+
+
 def test_profiler_spans_and_export(tmp_path):
     import paddle_tpu.profiler as profiler
     p = profiler.Profiler(

@@ -81,6 +81,27 @@ def test_internal_names_really_registered():
     assert "metrics.dropped_series" in catalog.internal_names()
 
 
+def test_serving_robustness_counters_cataloged():
+    """The ISSUE 14 outcome counters are the perf-gate's
+    'shed, never collapse' vocabulary: pin that each exists in the
+    catalog with the right kind AND has a real emission site in the
+    serving layer (not just a catalog entry someone forgot to wire)."""
+    emitted = _emitted_names()
+    expected = {
+        "serving.rejected": "counter",
+        "serving.timed_out": "counter",
+        "serving.cancelled": "counter",
+        "serving.step_retries": "counter",
+        "serving.quarantined": "counter",
+        "serving.degraded": "gauge",
+    }
+    for name, kind in expected.items():
+        assert name in catalog.CATALOG, name
+        assert catalog.CATALOG[name]["kind"] == kind, name
+        sites = emitted.get(name, [])
+        assert any("inference" in s for s in sites), (name, sites)
+
+
 def test_catalog_entries_well_formed():
     for name, d in catalog.CATALOG.items():
         assert d["kind"] in ("counter", "gauge", "histogram"), name
