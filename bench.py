@@ -506,6 +506,127 @@ def main():
             _ov["vs_cb_block16"] = round(
                 _ov["tokens_per_sec"] / _cb["tokens_per_sec"], 4)
 
+        # fault-resume rung (ISSUE 15): a mid-run crash injected at
+        # the train.step chaos site, recovered by run_resilient +
+        # FaultTolerantCheckpoint. Records time-to-recover (crash ->
+        # first post-resume step) and post-resume throughput as a
+        # within-window RATIO vs the same run uninterrupted — the
+        # drift-robust quantity the perf gate can pin.
+        fr_ck = base_ck = None
+        try:
+            if not _want("train_fault_resume"):
+                raise _SkipRung()
+            import tempfile
+
+            import paddle_tpu as paddle
+            from paddle_tpu import _chaos
+            from paddle_tpu import io as pio
+            from paddle_tpu import nn
+            from paddle_tpu.distributed.elastic import run_resilient
+            from paddle_tpu.hapi import (Callback, FaultTolerantCheckpoint,
+                                         Model)
+            from paddle_tpu.nn import functional as F_
+
+            FV, FS, FB, FSTEPS, FKILL = 8192, 512, 4, 12, 6
+
+            class _FRData(pio.Dataset):
+                def __len__(self):
+                    return FB * FSTEPS
+
+                def __getitem__(self, i):
+                    r = np.random.RandomState(i)
+                    a = r.randint(0, FV, (FS,)).astype(np.int64)
+                    return a, a
+
+            class _FRLM(nn.Layer):
+                def __init__(self):
+                    super().__init__()
+                    self.emb = nn.Embedding(FV, 256)
+                    self.h = nn.Linear(256, 256)
+                    self.act = nn.Tanh()
+                    self.out = nn.Linear(256, FV)
+
+                def forward(self, ids):
+                    return self.out(self.act(self.h(self.emb(ids))))
+
+            def _fr_loss(logits, labels):
+                return F_.cross_entropy(logits.reshape([-1, FV]),
+                                        labels.reshape([-1]))
+
+            class _Clock(Callback):
+                def __init__(self, sink):
+                    self.sink = sink
+
+                def on_train_batch_end(self, step, logs=None):
+                    self.sink.append(time.perf_counter())
+
+            def _fr_run(ck_root=None, sink=None):
+                paddle.seed(0)
+                net = _FRLM()
+                fr_m = Model(net)
+                fr_m.prepare(paddle.optimizer.SGD(
+                    0.01, parameters=net.parameters()), _fr_loss)
+                fr_dl = pio.DataLoader(_FRData(), batch_size=FB,
+                                       shuffle=True, seed=7)
+                fr_cbs = [_Clock(sink)] if sink is not None else []
+                if ck_root is not None:
+                    fr_cbs.append(FaultTolerantCheckpoint(
+                        ck_root, every_n_steps=2, dataloader=fr_dl))
+                fr_m.fit(fr_dl, epochs=1, verbose=0, callbacks=fr_cbs)
+
+            # baseline runs with the SAME checkpoint callback (chaos
+            # off): the ratio must isolate crash-recovery cost, not
+            # conflate it with checkpoint-write overhead
+            base_ck = tempfile.mkdtemp(prefix="bench_fault_base_")
+            base_sink = []
+            _fr_run(ck_root=base_ck, sink=base_sink)
+            # steady-state steps/s, excluding the compile-laden first step
+            base_sps = (len(base_sink) - 1) / \
+                (base_sink[-1] - base_sink[0])
+
+            fr_ck = tempfile.mkdtemp(prefix="bench_fault_resume_")
+            os.environ[_chaos.ENV] = "on"
+            _chaos.clear()
+            _chaos.install("train.step", kind="error", times=1,
+                           match=lambda c: c.get("step") == FKILL)
+            crash_t = {}
+            fr_sink = []
+            run_resilient(lambda attempt: _fr_run(fr_ck, fr_sink),
+                          max_restarts=2, backoff_s=0.05,
+                          on_restart=lambda a, e:
+                          crash_t.setdefault("t", time.perf_counter()))
+            post = [t for t in fr_sink if t > crash_t["t"]]
+            recover_s = post[0] - crash_t["t"]
+            post_sps = (len(post) - 1) / (post[-1] - post[0]) \
+                if len(post) > 1 else None
+            rungs["train_fault_resume"] = {
+                "killed_at_step": FKILL,
+                "recover_s": round(recover_s, 3),
+                "post_resume_tokens_per_sec":
+                    round(post_sps * FB * FS, 1) if post_sps else None,
+                "vs_uninterrupted":
+                    round(post_sps / base_sps, 4) if post_sps else None}
+        except _SkipRung:
+            pass
+        except Exception as e:  # noqa: BLE001
+            rungs["train_fault_resume"] = {
+                "error": f"{type(e).__name__}: {e}"}
+        finally:
+            # ALL cleanup here — a failed rung must not leave a live
+            # chaos rule in the process-global registry or temp
+            # checkpoint dirs on disk
+            try:
+                from paddle_tpu import _chaos as _chaos_cleanup
+                _chaos_cleanup.clear()
+            except Exception:  # noqa: BLE001
+                pass
+            os.environ.pop("PADDLE_TPU_CHAOS", None)
+            import shutil as _shutil
+            for _d in (fr_ck, base_ck):
+                if _d:
+                    _shutil.rmtree(_d, ignore_errors=True)
+        _cleanup()
+
     # A100@40%MFU proxy for this exact model (6*N + 12*L*H*S attention)
     flops_per_token = _gpt_flops_per_token(cfg, seq)
     a100_baseline = 0.4 * 312e12 / flops_per_token

@@ -81,7 +81,7 @@ _LAZY = {"vision", "hapi", "profiler", "static", "models", "parallel",
          "quantization", "utils", "text", "geometric", "audio",
          "regularizer", "sysconfig", "hub", "onnx", "tensor", "base",
          "callbacks", "dataset", "reader", "decomposition", "pir_utils",
-         "batch", "observability"}
+         "batch", "observability", "training"}
 import paddle_tpu.fft as fft  # noqa: F401
 import paddle_tpu.signal as signal  # noqa: F401
 

@@ -1,7 +1,17 @@
-"""Fault-injection hook points for the serving robustness suite.
+"""Fault-injection hook points for the serving AND training
+robustness suites.
 
 The serving stack calls :func:`hit` at NAMED SITES (e.g.
-``serving.decode_step``). When the ``PADDLE_TPU_CHAOS`` env var is
+``serving.decode_step``); the training stack (ISSUE 15) adds
+``train.step`` (hapi ``Model.train_batch`` + fleet
+``PipelineParallel.train_batch``, ctx ``step=``), ``train.data_fetch``
+(the ``fit`` loop's batch fetch), ``train.checkpoint_save``
+(``distributed.checkpoint.save_state_dict``'s write path, AFTER the
+stale commit marker is dropped — a fault there models a writer killed
+mid-save), and ``train.preempt`` (``FaultTolerantCheckpoint``'s step
+boundary — an injected error is treated as a delivered preemption
+notice, driving the flush-and-stop path without a real SIGTERM).
+When the ``PADDLE_TPU_CHAOS`` env var is
 unset — the production default — ``hit`` is a single dict/env check
 and nothing else ever runs; no rule matching, no allocation. With the
 env var set, installed rules can inject

@@ -162,9 +162,22 @@ class GradScaler:
         self._bad_steps = 0
         self._found_inf = False
         self._unscaled = False
+        self._last_skipped = False
 
     def is_enable(self):
         return self._enable
+
+    @property
+    def found_inf(self):
+        return self._found_inf
+
+    def last_step_skipped(self):
+        """True when the most recent ``step()`` skipped the optimizer
+        update because check_finite_and_unscale found non-finite
+        grads — the hook ``training.StepGuard.observe_scaler`` uses
+        so AMP's own skip-step semantics feed the circuit breaker
+        instead of being double-counted as NaN steps."""
+        return self._enable and self._last_skipped
 
     def is_use_dynamic_loss_scaling(self):
         return self._dynamic
@@ -210,6 +223,7 @@ class GradScaler:
             optimizer.step()
             return
         self._unscale(optimizer)
+        self._last_skipped = self._found_inf
         if not self._found_inf:
             optimizer.step()
         self._unscaled = False

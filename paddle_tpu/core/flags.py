@@ -111,6 +111,13 @@ define_flag("FLAGS_collective_debug", False,
             "NCCL_DEBUG analog")
 define_flag("FLAGS_watchdog_interval_s", 10.0,
             "collective watchdog probe interval")
+define_flag("FLAGS_step_timeout_s", 1800.0,
+            "train-step stall watchdog timeout (TrainStepWatchdog "
+            "default): a step exceeding it is aborted with a "
+            "straggler report instead of hanging silently")
+define_flag("FLAGS_max_bad_steps", 5,
+            "consecutive non-finite/skipped train steps before the "
+            "StepGuard circuit breaker aborts the run")
 define_flag("FLAGS_watchdog_store_root", "",
             "shared dir for cross-rank watchdog progress exchange; when "
             "set, a timeout dump names the straggler rank(s)")

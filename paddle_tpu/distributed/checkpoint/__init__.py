@@ -115,6 +115,11 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
                 os.remove(os.path.join(path, COMMIT_MARKER))
             except FileNotFoundError:
                 pass
+        # chaos site (ISSUE 15): a fault here models a writer killed
+        # mid-save — the stale marker is gone, nothing is committed,
+        # and resume must skip this directory
+        from paddle_tpu import _chaos
+        _chaos.hit("train.checkpoint_save", path=path)
         shard = os.path.join(path, f"shard_{pid}.npz")
         np.savez(shard + ".tmp.npz", **arrays)
         os.replace(shard + ".tmp.npz", shard)

@@ -67,7 +67,13 @@ class FileKVStore(KVStore):
         return os.path.join(self.root, key.replace("/", "__"))
 
     def put(self, key, value, ttl_s=None):
-        tmp = self._path(key) + ".tmp"
+        # unique tmp per writer: concurrent puts of the SAME key (a
+        # watchdog's arm-time publish racing its monitor thread's
+        # startup publish, or two hosts heartbeating one shared key)
+        # must not steal each other's tmp file — os.replace stays the
+        # single atomic point and last-writer-wins
+        tmp = self._path(key) + \
+            f".{os.getpid()}.{threading.get_ident()}.tmp"
         with open(tmp, "w") as f:
             f.write(_wrap_ttl(value, ttl_s))
         os.replace(tmp, self._path(key))
@@ -121,13 +127,73 @@ class TCPKVStore(KVStore):
     def get_prefix(self, prefix):
         out = {}
         for key, raw in self._store.list(prefix).items():
-            value = _unwrap_ttl(raw)
+            value, expired = _decode_ttl(raw)
             if value is not None:
                 out[key] = value
+            elif expired:
+                # lazy GC, matching FileKVStore: a long-running job's
+                # store must not grow unboundedly with dead nodes'
+                # keys. Only well-formed expired entries are removed;
+                # foreign/malformed values are left alone. Racing a
+                # concurrent re-put is benign (the next heartbeat
+                # restores the key).
+                try:
+                    self._store.delete_key(key)
+                except Exception:
+                    pass
         return out
 
     def delete(self, key):
         self._store.delete_key(key)
+
+
+def run_resilient(fn: Callable[[int], object], *, max_restarts: int = 3,
+                  backoff_s: float = 0.5, backoff_factor: float = 2.0,
+                  max_backoff_s: float = 30.0,
+                  restartable=(Exception,), on_restart=None):
+    """Single-host supervised restart (ISSUE 15): the in-process
+    analog of the launcher's ``--max_restarts`` relaunch loop, for
+    loops that recover from *catchable* crashes — an injected chaos
+    fault, a poisoned step, a transient runtime error — without
+    paying process teardown.
+
+    Calls ``fn(attempt)`` (attempt 0 first). When fn raises a
+    ``restartable`` exception, waits ``backoff_s * backoff_factor **
+    (attempt-1)`` (capped at ``max_backoff_s``), ticks the
+    ``train.restarts`` counter, calls ``on_restart(attempt, exc)`` if
+    given, and calls fn again — at most ``max_restarts`` restarts,
+    then the last exception propagates. ``KeyboardInterrupt`` /
+    ``SystemExit`` always propagate (the operator's ctrl-C must win).
+
+    Recovery of *state* is fn's job: build the loop with a
+    ``hapi.FaultTolerantCheckpoint`` (or call
+    ``training.load_train_checkpoint``) so every attempt resumes from
+    ``checkpoint.latest_committed()`` — the resume-equivalence test
+    proves crash+resume reproduces the uninterrupted run bitwise.
+    NOTE a ``training.NonFiniteStepError`` abort is deterministic for
+    a given data shard; restarting replays the same garbage, so the
+    breaker fires again and the supervisor gives up after the bounded
+    retries — by design it never converts a diagnostic abort into an
+    infinite crash loop."""
+    attempt = 0
+    while True:
+        try:
+            return fn(attempt)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except restartable as e:
+            attempt += 1
+            if attempt > max_restarts:
+                raise
+            from paddle_tpu.observability import metrics as _met
+            if _met._ENABLED:
+                _met.REGISTRY.counter("train.restarts").inc()
+            if on_restart is not None:
+                on_restart(attempt, e)
+            delay = min(backoff_s * (backoff_factor ** (attempt - 1)),
+                        max_backoff_s)
+            if delay > 0:
+                time.sleep(delay)
 
 
 class ElasticManager:

@@ -26,6 +26,7 @@ import time
 
 import numpy as np
 
+from paddle_tpu import _chaos
 from paddle_tpu.nn.layer.layers import Layer
 from paddle_tpu.observability import metrics as _met
 from paddle_tpu.observability import training as _otrain
@@ -51,6 +52,7 @@ class PipelineParallel(Layer):
         pp = hcg.get_pipe_parallel_world_size()
         if pp <= 1:
             raise ValueError("PipelineParallel needs pp_degree > 1")
+        self._steps_seen = 0
         topo = hcg.topology()
         for ax in ("sep", "sharding"):
             if ax in topo.get_hybrid_group_names() and \
@@ -152,12 +154,21 @@ class PipelineParallel(Layer):
         return self._sched
 
     def train_batch(self, data, optimizer, lr_scheduler=None,
-                    scaler=None):
+                    scaler=None, step_guard=None, watchdog=None):
         """One pipelined train step (reference train_batch contract):
         data = (inputs, labels); runs forward+backward through the
         compiled pipeline, applies the optimizer, steps the scheduler.
         The whole step is one jitted program (compiled on first call,
-        reused after)."""
+        reused after).
+
+        Robustness hooks (ISSUE 15): ``watchdog`` — a
+        ``TrainStepWatchdog`` armed around the step; a stall aborts
+        with a ``TrainHangError`` straggler report instead of hanging.
+        ``step_guard`` — a ``training.StepGuard`` run POST-step
+        (``observe_loss``): the fused program already applied the
+        update, so the guard detects non-finite losses and
+        circuit-breaks, while skip-step semantics belong to the
+        eager/hapi path."""
         if self._sched_error is not None:
             raise ValueError(self._sched_error)
         if scaler is not None:
@@ -193,9 +204,30 @@ class PipelineParallel(Layer):
                 _step, objs=[self._layers, optimizer])
             self._opt = optimizer
         x, y = data
+        step_idx = self._steps_seen
+        if watchdog is not None:
+            watchdog.step_begin(step_idx)
         t0 = time.perf_counter()
-        with self._mesh:
-            loss = self._step(x, y)
+        try:
+            _chaos.hit("train.step", step=step_idx)
+            with self._mesh:
+                loss = self._step(x, y)
+            if step_guard is not None or watchdog is not None:
+                # sync inside the armed window: a hung collective
+                # must trip the watchdog, not escape as an async value
+                loss_val = float(loss)
+        except KeyboardInterrupt:
+            err = watchdog.consume_abort() if watchdog is not None \
+                else None
+            if err is not None:
+                raise err from None
+            raise
+        finally:
+            if watchdog is not None:
+                watchdog.step_end()
+        self._steps_seen += 1
+        if step_guard is not None:
+            step_guard.observe_loss(loss_val, step=step_idx)
         if _met._ENABLED:
             # close the timing window on the step's completion, not its
             # async dispatch (a dispatch-only window reports impossible

@@ -9,6 +9,8 @@ from typing import List, Optional
 import numpy as np
 
 import paddle_tpu as paddle
+from paddle_tpu import _chaos
+from paddle_tpu import training as _ftrain
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.io import DataLoader
 from paddle_tpu.metric import Metric
@@ -84,6 +86,141 @@ class ModelCheckpoint(Callback):
     def on_epoch_end(self, epoch, logs=None):
         if self.save_dir and epoch % self.save_freq == 0:
             self.model.save(os.path.join(self.save_dir, str(epoch)))
+
+
+class FaultTolerantCheckpoint(Callback):
+    """Preemption-safe periodic checkpointing with exact resume
+    (ISSUE 15; reference posture: fleet/elastic auto-resume).
+
+    Every ``every_n_steps`` completed optimizer steps — and, crucially,
+    when a preemption notice arrives (SIGTERM by default, or the
+    ``train.preempt`` chaos site in drills) — the callback flushes a
+    COMMITTED checkpoint (``_COMMITTED.json`` protocol) holding model
+    + optimizer tensors, the default-Generator RNG state, and the
+    dataloader position, then stops ``fit`` cleanly at the step
+    boundary. On the next run, ``on_train_begin`` resumes from
+    ``latest_committed(root)``: parameters restore in place and the
+    dataloader fast-forwards so the run consumes the EXACT remaining
+    data order (proven bitwise by tests/test_train_robustness.py).
+
+    Pass the SAME ``DataLoader`` instance to both ``fit`` and this
+    callback (and give it a ``seed`` for reproducible shuffling) —
+    the loader's position is part of the checkpoint."""
+
+    def __init__(self, root, every_n_steps=1, dataloader=None,
+                 scaler=None, resume=True, install_signal_handler=True,
+                 signals=None, keep_last=None):
+        self.root = root
+        self.every_n_steps = int(every_n_steps)
+        self.dataloader = dataloader
+        self.scaler = scaler
+        self.resume = resume
+        self.keep_last = keep_last
+        self.global_step = 0          # completed optimizer steps
+        self.fit_epoch = 0            # fit epoch currently running
+        self.resumed_from = None
+        self.preempted = False
+        self.stopped = False
+        self._handler = None
+        if install_signal_handler:
+            import signal as _signal
+            sigs = signals if signals is not None else (_signal.SIGTERM,)
+            self._handler = _ftrain.PreemptionHandler(sigs)
+
+    def on_train_begin(self, logs=None):
+        os.makedirs(self.root, exist_ok=True)
+        # a REUSED callback (the natural resume-retry pattern: call
+        # fit again with the same instance) must not carry a consumed
+        # preemption notice into the next run — it would stop every
+        # subsequent fit after one batch
+        self.stopped = False
+        self.preempted = False
+        if self.resume:
+            # resume BEFORE installing the signal handler: a failed
+            # load (seed mismatch, corrupt checkpoint) must not leave
+            # a flag-only SIGTERM handler installed on an abandoned
+            # run — it would swallow every later real preemption
+            meta = _ftrain.load_train_checkpoint(
+                self.root, self.model.network, self.model._optimizer,
+                self.dataloader, self.scaler)
+            if meta is not None:
+                self.global_step = int(meta["step"])
+                self.fit_epoch = int(meta.get("epoch", 0))
+                self.resumed_from = meta["path"]
+                # chaos/step-guard contexts key on the GLOBAL step,
+                # and fit's epoch BUDGET must not re-run completed
+                # epochs (the loader position covers the partial one)
+                self.model._steps_seen = self.global_step
+                self.model._initial_epoch = self.fit_epoch
+                self._normalize_epoch_boundary()
+        if self._handler is not None:
+            self._handler.triggered = False
+            self._handler.install()
+
+    def _normalize_epoch_boundary(self):
+        """A checkpoint flushed at an epoch's FINAL batch restores as
+        (epoch e, all batches served): re-entering epoch e would yield
+        zero batches but still fire on_epoch_end/eval a second time
+        (double-stepping epoch-wise LR schedulers, double-counting
+        early-stop patience). Normalize to the equivalent position —
+        the start of epoch e+1 — for both the loader and fit's epoch
+        budget."""
+        dl = self.dataloader
+        if dl is None or not hasattr(dl, "state_dict"):
+            return
+        st = dl.state_dict()
+        try:
+            per_epoch = len(dl)
+        except TypeError:
+            return
+        if per_epoch and st["batches_served"] >= per_epoch:
+            dl.set_state_dict({"epoch": st["epoch"] + 1,
+                               "batches_served": 0,
+                               "seed": st["seed"]})
+            self.fit_epoch += 1
+            self.model._initial_epoch = self.fit_epoch
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.fit_epoch = int(epoch)
+
+    def on_train_batch_end(self, step, logs=None):
+        gs = self.global_step = self.global_step + 1
+        preempt = self._handler is not None and self._handler.triggered
+        try:
+            _chaos.hit("train.preempt", step=gs)
+        except _chaos.ChaosError:
+            preempt = True       # injected preemption notice (drills)
+        if preempt:
+            self._flush(gs)
+            if _met._ENABLED:
+                _met.REGISTRY.counter("train.preemptions").inc()
+            self.preempted = True
+            self.stopped = True           # fit stops at this batch
+            self.model.stop_training = True
+            return
+        if self.every_n_steps and gs % self.every_n_steps == 0:
+            self._flush(gs)
+
+    def on_train_end(self, logs=None):
+        if self._handler is not None:
+            self._handler.restore()
+
+    def _flush(self, gs):
+        _ftrain.save_train_checkpoint(
+            self.root, gs, self.model.network, self.model._optimizer,
+            self.dataloader, self.scaler, epoch=self.fit_epoch)
+        if self.keep_last:
+            self._prune()
+
+    def _prune(self):
+        import shutil
+        from paddle_tpu.distributed import checkpoint as dc
+        committed = [d for d in sorted(os.listdir(self.root))
+                     if d.startswith("step_")
+                     and dc.is_committed(os.path.join(self.root, d))]
+        for d in committed[:-self.keep_last]:
+            shutil.rmtree(os.path.join(self.root, d),
+                          ignore_errors=True)
 
 
 class EarlyStopping(Callback):
@@ -258,11 +395,23 @@ class Model:
         self._loss = None
         self._metrics: List[Metric] = []
         self.stop_training = False
+        self._step_guard = None
+        self._watchdog = None
+        self._steps_seen = 0
+        self._initial_epoch = 0
 
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None):
+                amp_configs=None, step_guard=None, watchdog=None):
+        """``step_guard``: a ``training.StepGuard`` giving train_batch
+        skip-step semantics on non-finite loss/grads plus the
+        consecutive-bad circuit breaker. ``watchdog``: a
+        ``distributed.watchdog.TrainStepWatchdog`` armed around every
+        step — a stalled step aborts with a ``TrainHangError``
+        straggler report instead of hanging silently."""
         self._optimizer = optimizer
         self._loss = loss
+        self._step_guard = step_guard
+        self._watchdog = watchdog
         if metrics is None:
             self._metrics = []
         else:
@@ -275,17 +424,52 @@ class Model:
         # unconditional: enabling metrics mid-step must not record a
         # dt measured from 0.0 (perf_counter is ~ns, no cost to skip)
         t0 = time.perf_counter()
-        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-        labels = labels if labels is None or isinstance(
-            labels, (list, tuple)) else [labels]
-        outputs = self.network(*inputs)
-        losses = self._loss(outputs, *labels) if labels is not None \
-            else outputs
-        loss = losses if isinstance(losses, Tensor) else sum(losses)
-        loss.backward()
-        self._optimizer.step()
-        self._optimizer.clear_grad()
-        loss_val = float(loss)
+        step_idx = self._steps_seen
+        wd = self._watchdog
+        if wd is not None:
+            wd.step_begin(step_idx)
+        skipped = False
+        try:
+            _chaos.hit("train.step", step=step_idx)
+            inputs = inputs if isinstance(inputs, (list, tuple)) \
+                else [inputs]
+            labels = labels if labels is None or isinstance(
+                labels, (list, tuple)) else [labels]
+            outputs = self.network(*inputs)
+            losses = self._loss(outputs, *labels) if labels is not None \
+                else outputs
+            loss = losses if isinstance(losses, Tensor) else sum(losses)
+            loss.backward()
+            guard = self._step_guard
+            if guard is not None and not guard.pre_step(
+                    loss, self._optimizer, step=step_idx):
+                # skip-step: non-finite loss/grads — drop this update,
+                # keep the run alive (pre_step's circuit breaker
+                # aborts when bad steps persist)
+                self._optimizer.clear_grad()
+                skipped = True
+            else:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+            loss_val = float(loss)
+        except KeyboardInterrupt:
+            # translate on the abort TOKEN, not trip state: a
+            # late-landing watchdog SIGINT (next step already re-armed)
+            # is still a hang abort; a genuine ctrl-C never carries a
+            # token and propagates
+            err = wd.consume_abort() if wd is not None else None
+            if err is not None:
+                raise err from None
+            raise
+        finally:
+            if wd is not None:
+                wd.step_end()
+        self._steps_seen += 1
+        if skipped:
+            # not an optimizer step: keep MFU/step-time clean and the
+            # metric accumulators unpolluted by the bad batch
+            metrics = [m.accumulate() for m in self._metrics]
+            return ([loss_val], metrics) if metrics else [loss_val]
         if _met._ENABLED:
             # timed AFTER the float(loss) device sync: the step's true
             # end — timing only the async dispatch would report
@@ -353,50 +537,131 @@ class Model:
             cbs.append(ModelCheckpoint(save_freq, save_dir))
         for cb in cbs:
             cb.set_model(self)
-        for cb in cbs:
-            cb.on_train_begin()
         it = 0
         history = {"loss": []}
-        for epoch in range(epochs):
-            for m in self._metrics:
-                m.reset()
+        stop = False
+        self.stop_training = False
+        try:
+            # on_train_begin INSIDE the try: a callback that fails
+            # here (e.g. a refused resume) must still get the
+            # finally's on_train_end cleanup — signal handlers and
+            # file sinks cannot leak on a failed start
             for cb in cbs:
-                cb.on_epoch_begin(epoch)
-            logs = {}
-            for step, batch in enumerate(loader):
+                cb.on_train_begin()
+            # a resume (FaultTolerantCheckpoint) sets _initial_epoch
+            # so the epoch BUDGET carries across a restart: completed
+            # epochs are not re-run (the dataloader's own restored
+            # position covers the partial one). One-shot: consumed
+            # here, reset for later fits.
+            start_epoch = self._initial_epoch
+            self._initial_epoch = 0
+            for epoch in range(start_epoch, epochs):
+                for m in self._metrics:
+                    m.reset()
                 for cb in cbs:
-                    cb.on_train_batch_begin(step)
-                batch = batch if isinstance(batch, (list, tuple)) else \
-                    [batch]
-                ins, labs = batch[:-1], batch[-1:]
-                if len(batch) == 1:
-                    ins, labs = batch, None
-                res = self.train_batch(list(ins), labs)
-                loss_val = res[0][0] if isinstance(res, tuple) else res[0]
-                logs = {"loss": loss_val}
-                if isinstance(res, tuple):
-                    for m, v in zip(self._metrics, res[1]):
-                        logs[m.name()] = v
-                history["loss"].append(loss_val)
+                    cb.on_epoch_begin(epoch)
+                logs = {}
+                data_iter = iter(loader)
+                step = 0
+                try:
+                    while True:
+                        _chaos.hit("train.data_fetch", epoch=epoch,
+                                   step=it)
+                        try:
+                            batch = next(data_iter)
+                        except StopIteration:
+                            break
+                        for cb in cbs:
+                            cb.on_train_batch_begin(step)
+                        batch = batch if isinstance(batch,
+                                                    (list, tuple)) \
+                            else [batch]
+                        ins, labs = batch[:-1], batch[-1:]
+                        if len(batch) == 1:
+                            ins, labs = batch, None
+                        res = self.train_batch(list(ins), labs)
+                        loss_val = res[0][0] if isinstance(res, tuple) \
+                            else res[0]
+                        logs = {"loss": loss_val}
+                        if isinstance(res, tuple):
+                            for m, v in zip(self._metrics, res[1]):
+                                logs[m.name()] = v
+                        history["loss"].append(loss_val)
+                        for cb in cbs:
+                            cb.on_train_batch_end(step, logs)
+                        it += 1
+                        step += 1
+                        if self.stop_training or any(
+                                getattr(cb, "stopped", False)
+                                for cb in cbs):
+                            # preemption / early stop honored at the
+                            # step boundary, mid-epoch
+                            stop = True
+                            break
+                        if num_iters is not None and it >= num_iters:
+                            # unlike the preemption stop above, a
+                            # num_iters exit ends the RUN (never
+                            # resumed back into this epoch), so the
+                            # long-standing fire-epoch-end-after-break
+                            # behavior cannot double-step anything —
+                            # kept for compatibility
+                            break
+                finally:
+                    # deterministic release on every exit (preempt,
+                    # crash, num_iters): an abandoned loader iterator
+                    # must unwind its prefetch machinery now, not at
+                    # a later GC
+                    close = getattr(data_iter, "close", None)
+                    if close is not None:
+                        close()
+                if stop:
+                    # the epoch was cut short (preemption / stop flag):
+                    # its end-of-epoch hooks belong to the RESUMED run
+                    # — firing them here would double-step epoch-wise
+                    # LR schedulers and early-stop patience
+                    break
                 for cb in cbs:
-                    cb.on_train_batch_end(step, logs)
-                it += 1
+                    cb.on_epoch_end(epoch, logs)
+                if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                    eval_logs = self.evaluate(eval_data,
+                                              batch_size=batch_size,
+                                              verbose=0)
+                    for cb in cbs:
+                        cb.on_eval_end(eval_logs)
+                if any(getattr(cb, "stopped", False) for cb in cbs):
+                    break
                 if num_iters is not None and it >= num_iters:
                     break
+        except KeyboardInterrupt:
+            # a watchdog abort whose SIGINT lands between steps (the
+            # step completed while the monitor was dumping) must still
+            # surface as a hang report, not a bare ctrl-C — the abort
+            # token distinguishes the two
+            wd = self._watchdog
+            err = wd.consume_abort() if wd is not None else None
+            if err is not None:
+                raise err from None
+            raise
+        finally:
+            # ALWAYS — even when an attempt crashes mid-loop: a leaked
+            # SIGTERM handler on a dead callback would swallow the next
+            # attempt's preemption notice, and file-backed callbacks
+            # must close their sinks. Per-callback isolation: one sink
+            # failing to close must neither skip another's cleanup nor
+            # mask the in-flight training exception.
+            import sys as _sys
+            in_flight = _sys.exc_info()[0] is not None
+            cleanup_err = None
             for cb in cbs:
-                cb.on_epoch_end(epoch, logs)
-            if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                eval_logs = self.evaluate(eval_data,
-                                          batch_size=batch_size,
-                                          verbose=0)
-                for cb in cbs:
-                    cb.on_eval_end(eval_logs)
-            if any(getattr(cb, "stopped", False) for cb in cbs):
-                break
-            if num_iters is not None and it >= num_iters:
-                break
-        for cb in cbs:
-            cb.on_train_end()
+                try:
+                    cb.on_train_end()
+                except Exception as ce:  # noqa: BLE001
+                    if cleanup_err is None:
+                        cleanup_err = ce
+                    import traceback
+                    traceback.print_exc()
+            if cleanup_err is not None and not in_flight:
+                raise cleanup_err
         return history
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=1,

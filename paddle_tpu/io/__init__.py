@@ -143,10 +143,19 @@ class SequenceSampler(Sampler):
 
 class RandomSampler(Sampler):
     def __init__(self, data_source, replacement=False, num_samples=None,
-                 generator=None):
+                 generator=None, seed=None):
         super().__init__(data_source)
         self.replacement = replacement
         self._num_samples = num_samples
+        #: seeded mode: epoch `e`'s draw is a pure function of
+        #: (seed, e) — the restorable-position contract DataLoader
+        #: resume relies on (the DistributedBatchSampler idiom).
+        #: seed=None keeps the legacy global-RNG behavior.
+        self.seed = seed
+        self.epoch = 0
+
+    def set_epoch(self, epoch):
+        self.epoch = int(epoch)
 
     @property
     def num_samples(self):
@@ -154,6 +163,12 @@ class RandomSampler(Sampler):
 
     def __iter__(self):
         n = len(self.data_source)
+        if self.seed is not None:
+            rng = np.random.RandomState(
+                (int(self.seed) + 1000003 * self.epoch) % (2 ** 32))
+            if self.replacement:
+                return iter(rng.randint(0, n, self.num_samples).tolist())
+            return iter(rng.permutation(n)[: self.num_samples].tolist())
         if self.replacement:
             return iter(np.random.randint(0, n, self.num_samples).tolist())
         return iter(np.random.permutation(n)[: self.num_samples].tolist())
@@ -208,6 +223,10 @@ class BatchSampler(Sampler):
             self.sampler = RandomSampler(dataset)
         else:
             self.sampler = SequenceSampler(dataset)
+
+    def set_epoch(self, epoch):
+        if hasattr(self.sampler, "set_epoch"):
+            self.sampler.set_epoch(epoch)
 
     def __iter__(self):
         batch = []
@@ -308,7 +327,7 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, seed=None):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
@@ -319,6 +338,18 @@ class DataLoader:
         self._iterable_mode = isinstance(dataset, IterableDataset)
         self.batch_size = batch_size
         self.drop_last = drop_last
+        if seed is not None and (self._iterable_mode
+                                 or batch_sampler is not None
+                                 or batch_size is None):
+            # seed only governs the loader-BUILT sampler; silently
+            # storing it next to an external/iterable ordering would
+            # let a resume fast-forward a permutation the seed never
+            # produced (claiming exact replay while corrupting order)
+            raise ValueError(
+                "DataLoader(seed=...) requires the loader-built batch "
+                "sampler (map-style dataset, batch_size set, no "
+                "external batch_sampler) — an external sampler owns "
+                "its ordering and must carry its own seed/epoch state")
         if self._iterable_mode:
             self.batch_sampler = None
         elif batch_sampler is not None:
@@ -326,9 +357,57 @@ class DataLoader:
         elif batch_size is None:
             self.batch_sampler = None
         else:
+            sampler = RandomSampler(dataset, seed=seed) \
+                if (shuffle and seed is not None) else None
             self.batch_sampler = BatchSampler(
-                dataset, shuffle=shuffle, batch_size=batch_size,
-                drop_last=drop_last)
+                dataset, sampler=sampler, shuffle=shuffle,
+                batch_size=batch_size, drop_last=drop_last)
+        # resumable position (ISSUE 15): with `seed` set, the shuffle
+        # order is a pure function of (seed, epoch) and the loader's
+        # position is three ints — what preemption-safe checkpoints
+        # capture so a resume replays the exact data order.
+        self._seed = seed
+        self._epoch = 0
+        self._batches_served = 0
+        self._skip_next = 0
+        self._auto_epoch = (batch_sampler is None
+                            and self.batch_sampler is not None)
+
+    # ---------------------------------------------- resumable position
+    def set_epoch(self, epoch):
+        self._epoch = int(epoch)
+
+    def state_dict(self):
+        """Loader position for preemption-safe checkpoints: epoch,
+        batches already CONSUMED this epoch, and the shuffle seed."""
+        return {"epoch": int(self._epoch),
+                "batches_served": int(self._batches_served),
+                "seed": self._seed}
+
+    def set_state_dict(self, state):
+        saved_seed = state.get("seed")
+        if saved_seed != self._seed:
+            # EITHER direction (including seed=None on one side): a
+            # position under one shuffle order is meaningless under
+            # another — silently fast-forwarding a different
+            # permutation would re-train some samples and skip others
+            # while claiming exact resume
+            raise ValueError(
+                f"DataLoader resume: checkpoint shuffle seed "
+                f"{saved_seed!r} != this loader's seed {self._seed!r} "
+                "— the saved data order cannot be replayed")
+        self._epoch = int(state.get("epoch", 0))
+        self._skip_next = int(state.get("batches_served", 0))
+        self._batches_served = self._skip_next
+        if self._skip_next and self._seed is None and self._auto_epoch \
+                and isinstance(getattr(self.batch_sampler, "sampler",
+                                       None), RandomSampler):
+            import warnings
+            warnings.warn(
+                "DataLoader resume with unseeded shuffle: the position "
+                "is restored but the permutation is not reproducible — "
+                "pass DataLoader(..., seed=N) for exact data-order "
+                "replay", stacklevel=2)
 
     def __len__(self):
         if self._iterable_mode:
@@ -337,12 +416,21 @@ class DataLoader:
             return len(self.dataset)
         return len(self.batch_sampler)
 
-    def _produce(self):
+    def _produce(self, skip=0):
+        # skip: batches already consumed before a resume. Index-driven
+        # modes fast-forward WITHOUT loading the skipped samples;
+        # iterable datasets must consume (and drop) them.
         if self._iterable_mode:
             it = iter(self.dataset)
             if self.batch_size is None:
+                for _ in itertools.islice(it, skip):
+                    pass
                 yield from (self.collate_fn([s]) for s in it)
                 return
+            while skip > 0:
+                if not list(itertools.islice(it, self.batch_size)):
+                    return
+                skip -= 1
             while True:
                 batch = list(itertools.islice(it, self.batch_size))
                 if not batch:
@@ -351,16 +439,32 @@ class DataLoader:
                     return
                 yield self.collate_fn(batch)
         elif self.batch_sampler is None:
-            for i in range(len(self.dataset)):
+            for i in range(skip, len(self.dataset)):
                 yield self.collate_fn([self.dataset[i]])
         else:
             for batch_idx in self.batch_sampler:
+                if skip > 0:
+                    skip -= 1
+                    continue
                 yield self.collate_fn(
                     [self.dataset[i] for i in batch_idx])
 
     def __iter__(self):
+        # epoch sync + fast-forward happen here (once per pass), so
+        # every loading mode shares the resume semantics; position is
+        # counted at CONSUMPTION (prefetch queues may hold more)
+        if self._auto_epoch and hasattr(self.batch_sampler, "set_epoch"):
+            self.batch_sampler.set_epoch(self._epoch)
+        skip = self._skip_next
+        self._skip_next = 0
+        self._batches_served = skip
+        inner = self._iter_batches(skip)
         if not _met._ENABLED:
-            yield from self._iter_batches()
+            for item in inner:
+                self._batches_served += 1
+                yield item
+            self._epoch += 1
+            self._batches_served = 0
             return
         # fetch-wait accounting: how long the consumer (the train loop)
         # blocks per batch — the input-pipeline stall signal. Covers
@@ -368,44 +472,64 @@ class DataLoader:
         hist = _met.REGISTRY.histogram("dataloader.fetch_wait_s")
         batches = _met.REGISTRY.counter("dataloader.batches")
         import time as _time
-        inner = self._iter_batches()
         while True:
             t0 = _time.perf_counter()
             try:
                 item = next(inner)
             except StopIteration:
+                self._epoch += 1
+                self._batches_served = 0
                 return
             hist.observe(_time.perf_counter() - t0)
             batches.inc()
+            self._batches_served += 1
             yield item
 
-    def _iter_batches(self):
+    def _iter_batches(self, skip=0):
         if not self.use_buffer_reader or self.num_workers == 0:
-            yield from self._produce()
+            yield from self._produce(skip)
             return
         if not self._iterable_mode and self.batch_sampler is not None \
                 and self.num_workers > 1:
-            yield from self._iter_multiprocess()
+            yield from self._iter_multiprocess(skip)
             return
         # background-thread prefetch (buffered reader / blocking-queue role)
         q: "queue.Queue" = queue.Queue(
             maxsize=max(2, self.prefetch_factor * max(self.num_workers, 1)))
         stop = object()
+        abandoned = threading.Event()
+
+        def _put(item) -> bool:
+            # bounded-blocking put that gives up when the consumer
+            # abandoned the iterator (mid-epoch preemption / crash):
+            # a worker stuck forever on q.put would leak one thread
+            # plus its buffered batches per crashed attempt
+            while not abandoned.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    pass
+            return False
 
         def worker():
             try:
-                for item in self._produce():
-                    q.put(item)
+                for item in self._produce(skip):
+                    if not _put(item):
+                        return
             finally:
-                q.put(stop)
+                _put(stop)
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is stop:
-                break
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is stop:
+                    break
+                yield item
+        finally:
+            abandoned.set()
 
     # ----------------------------------------------------------------
     # True multi-process loading (reference
@@ -413,7 +537,7 @@ class DataLoader:
     # worker processes pull index batches, collate to numpy, push
     # results; the parent reorders to keep sampler determinism).
     # ----------------------------------------------------------------
-    def _iter_multiprocess(self):
+    def _iter_multiprocess(self, skip=0):
         import multiprocessing as mp
         ctx = mp.get_context("fork")
         n = self.num_workers
@@ -429,7 +553,7 @@ class DataLoader:
         for p in procs:
             p.start()
         try:
-            batches = list(self.batch_sampler)
+            batches = list(self.batch_sampler)[skip:]
             for seq, b in enumerate(batches):
                 idx_queues[seq % n].put((seq, list(b)))
             for iq in idx_queues:

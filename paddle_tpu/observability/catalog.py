@@ -32,6 +32,25 @@ CATALOG = {
     "train.tokens_per_s": _m("gauge", "tokens/s of the last step"),
     "train.mfu": _m("gauge",
                     "achieved model-flops utilization of the last step"),
+    # ------------------------------------- training robustness (ISSUE 15)
+    "train.nan_steps": _m(
+        "counter", "train steps whose loss/grads were non-finite "
+        "(step guard detections)"),
+    "train.skipped_steps": _m(
+        "counter", "optimizer updates skipped by the step guard or "
+        "the AMP loss scaler"),
+    "train.hang_aborts": _m(
+        "counter", "train steps aborted by the stall/collective "
+        "watchdog instead of hanging"),
+    "train.straggler_ranks": _m(
+        "gauge", "straggler ranks named by the last hang report"),
+    "train.preemptions": _m(
+        "counter", "preemption notices honored with a committed "
+        "checkpoint flush before exit"),
+    "train.checkpoint_saves": _m(
+        "counter", "committed train-state checkpoints written"),
+    "train.restarts": _m(
+        "counter", "supervised in-process restarts (run_resilient)"),
     # ------------------------------------------------- jit / compiles
     "jit.xla_compiles": _m("counter",
                            "XLA executable builds process-wide"),

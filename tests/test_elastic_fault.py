@@ -72,6 +72,49 @@ def test_filekvstore_gc_purges_expired_entries(tmp_path):
     assert "elastic__job__nodes__raw" in names  # foreign file kept
 
 
+def test_kvstore_ttl_semantics_parity_file_vs_tcp(tmp_path):
+    """ISSUE 15 satellite: FileKVStore and TCPKVStore must expire keys
+    IDENTICALLY — read-side TTL from the same payload stamp, lazy
+    physical GC of well-formed expired entries, re-put after expiry
+    visible again, and delete of a missing key a no-op. The TCP store
+    rode untested for TTL until now (its expired entries also used to
+    pile up server-side forever; get_prefix now GCs them like the file
+    store does)."""
+    import socket
+
+    from paddle_tpu import native
+    from paddle_tpu.distributed.elastic import TCPKVStore
+
+    if native.get_lib() is None:
+        pytest.skip("native library unavailable")
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    stores = {"file": FileKVStore(str(tmp_path)),
+              "tcp": TCPKVStore("127.0.0.1", port, is_master=True)}
+    for st in stores.values():
+        st.put("par/keep", "v", ttl_s=60.0)
+        st.put("par/gone", "v", ttl_s=0.05)
+        st.put("par/forever", "v")
+    time.sleep(0.1)
+    views = {name: st.get_prefix("par/") for name, st in stores.items()}
+    assert views["file"] == views["tcp"] == \
+        {"par/keep": "v", "par/forever": "v"}
+    # expired entries were physically GC'd by the read, in BOTH stores
+    assert "par__gone" not in {p.name for p in tmp_path.iterdir()}
+    assert "par/gone" not in stores["tcp"]._store.list("par/")
+    # a re-put of an expired key becomes visible again
+    for name, st in stores.items():
+        st.put("par/gone", "v2", ttl_s=60.0)
+        assert st.get_prefix("par/").get("par/gone") == "v2", name
+    # delete parity, including deleting a key that never existed
+    for name, st in stores.items():
+        st.delete("par/keep")
+        st.delete("par/never-existed")
+        assert "par/keep" not in st.get_prefix("par/"), name
+
+
 def test_launcher_kills_job_on_worker_failure(tmp_path):
     """The launcher's failure policy (reference launch controllers):
     one worker exiting nonzero terminates the whole job with its

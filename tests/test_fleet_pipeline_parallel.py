@@ -117,6 +117,56 @@ def test_schedule_mode_guards():
             optimizer=None)
 
 
+def test_schedule_mode_error_lists_modes_and_raises_every_call():
+    """ISSUE 15 satellite pinning the DEFERRED error path's contract:
+    the wrap stays a fully working facade (forward AND state_dict),
+    the error text names every supported mode so a config typo is
+    self-diagnosing, and train_batch raises on EVERY call — a retry
+    loop must not accidentally 'recover' from a config error."""
+    _init_fleet("Eager1F1B")
+    wrapped = fleet.distributed_model(_make_pipeline_layer())
+    assert wrapped.pp_schedule is None
+    x = paddle.to_tensor(np.zeros((4, 8), "f4"))
+    assert wrapped(x).shape == [4, 4]
+    assert wrapped.state_dict()
+    y = paddle.to_tensor(np.zeros((4,), "int64"))
+    for _ in range(2):
+        with pytest.raises(ValueError) as ei:
+            wrapped.train_batch((x, y), optimizer=None)
+        for mode in ("1F1B", "ZBH1", "ZBVPP"):
+            assert mode in str(ei.value), str(ei.value)
+
+
+def test_train_batch_step_guard_detects_nonfinite_fused_step():
+    """ISSUE 15: the fused pipeline step cannot skip an already-applied
+    update, so the guard's fleet contract is detect + circuit-break:
+    a poisoned batch ticks train.nan_steps and the breaker aborts."""
+    import jax
+    if not hasattr(jax.lax, "axis_size"):
+        pytest.skip("jax API drift: lax.axis_size unavailable — the "
+                    "compiled pipeline step fails at HEAD on this "
+                    "container (same gate as the schedule-mode tests)")
+    import paddle_tpu.observability as obs
+    from paddle_tpu.training import NonFiniteStepError, StepGuard
+
+    obs.enable()
+    obs.REGISTRY.reset()
+    _init_fleet("1F1B")
+    model = fleet.distributed_model(_make_pipeline_layer())
+    opt = paddle.optimizer.SGD(0.05, parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 8).astype("f4"))
+    y = paddle.to_tensor(rng.randint(0, 4, (8,)).astype("int64"))
+    guard = StepGuard(max_consecutive_bad=1)
+    model.train_batch((x, y), opt, step_guard=guard)   # finite: fine
+    assert guard.nan_steps == 0
+    bad = paddle.to_tensor(np.full((8, 8), np.inf, "f4"))
+    with pytest.raises(NonFiniteStepError):
+        model.train_batch((bad, y), opt, step_guard=guard)
+    assert guard.nan_steps == 1
+    assert obs.counter("train.nan_steps").value == 1
+
+
 def test_heterogeneous_chain_passes_through_with_warning():
     """Structural incapability (no homogeneous block run) keeps the old
     pass-through behavior — forward works, a warning names the limit —

@@ -102,6 +102,27 @@ def test_serving_robustness_counters_cataloged():
         assert any("inference" in s for s in sites), (name, sites)
 
 
+def test_training_robustness_counters_cataloged():
+    """The ISSUE 15 train.* names are the training fault-drill
+    vocabulary: pin that each exists in the catalog with the right
+    kind AND has a real emission site in the layer that owns it."""
+    emitted = _emitted_names()
+    expected = {
+        "train.nan_steps": ("counter", "paddle_tpu/training"),
+        "train.skipped_steps": ("counter", "paddle_tpu/training"),
+        "train.checkpoint_saves": ("counter", "paddle_tpu/training"),
+        "train.hang_aborts": ("counter", "watchdog"),
+        "train.straggler_ranks": ("gauge", "watchdog"),
+        "train.restarts": ("counter", "elastic"),
+        "train.preemptions": ("counter", "hapi"),
+    }
+    for name, (kind, where) in expected.items():
+        assert name in catalog.CATALOG, name
+        assert catalog.CATALOG[name]["kind"] == kind, name
+        sites = emitted.get(name, [])
+        assert any(where in s for s in sites), (name, sites)
+
+
 def test_catalog_entries_well_formed():
     for name, d in catalog.CATALOG.items():
         assert d["kind"] in ("counter", "gauge", "histogram"), name

@@ -1,0 +1,773 @@
+"""Training under fire (ISSUE 15): step guards with skip-step +
+circuit-breaker semantics, per-step stall watchdog with straggler
+attribution, preemption-safe checkpointing with exact resume, and the
+run_resilient crash-resume supervisor — every path driven by the
+paddle_tpu._chaos training hook sites.
+
+Everything here is a tiny eager MLP on CPU; the chaos-marked tests
+carry the `chaos` marker (pytest.ini) and the whole module stays well
+under the tier-1 budget."""
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.observability as obs
+from paddle_tpu import _chaos
+from paddle_tpu import io as pio
+from paddle_tpu import nn
+from paddle_tpu.amp import GradScaler
+from paddle_tpu.distributed import checkpoint as dc
+from paddle_tpu.distributed.elastic import FileKVStore, run_resilient
+from paddle_tpu.distributed.watchdog import (TrainHangError,
+                                             TrainStepWatchdog)
+from paddle_tpu.hapi import Callback, FaultTolerantCheckpoint, Model
+from paddle_tpu.training import (NonFiniteStepError, PreemptionHandler,
+                                 StepGuard, load_train_checkpoint,
+                                 save_train_checkpoint)
+
+
+@pytest.fixture(autouse=True)
+def _metrics_on():
+    obs.enable()
+    obs.REGISTRY.reset()
+    yield
+    obs.enable()
+
+
+#: dataset item loads recorded here — the data-order oracle
+_SERVED = []
+
+
+class _RecData(pio.Dataset):
+    def __len__(self):
+        return 32
+
+    def __getitem__(self, i):
+        _SERVED.append(i)
+        r = np.random.RandomState(i)
+        return (r.randn(4).astype("f4"), r.randn(2).astype("f4"))
+
+
+def _build(seed=123):
+    paddle.seed(7)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    model = Model(net)
+    model.prepare(paddle.optimizer.SGD(0.1, parameters=net.parameters()),
+                  nn.MSELoss())
+    loader = pio.DataLoader(_RecData(), batch_size=4, shuffle=True,
+                            seed=seed)
+    return model, loader
+
+
+def _params(model):
+    return {k: v.numpy().copy()
+            for k, v in model.network.state_dict().items()}
+
+
+def _arm():
+    os.environ[_chaos.ENV] = "on"
+    _chaos.clear()
+
+
+# ------------------------------------------------------------ step guards
+def test_step_guard_skips_nonfinite_step_and_ticks_counters():
+    model, _ = _build()
+    guard = StepGuard(max_consecutive_bad=3)
+    model._step_guard = guard
+    bad = paddle.to_tensor(np.full((4, 4), np.inf, "f4"))
+    good = paddle.to_tensor(np.random.randn(4, 4).astype("f4"))
+    y = paddle.to_tensor(np.zeros((4, 2), "f4"))
+
+    before = _params(model)
+    out = model.train_batch(bad, y)
+    after = _params(model)
+    # the update was SKIPPED: parameters untouched, run alive
+    for k in before:
+        np.testing.assert_array_equal(before[k], after[k])
+    assert not np.isfinite(out[0])
+    assert guard.nan_steps == 1 and guard.skipped_steps == 1
+    assert guard.consecutive_bad == 1
+    assert obs.counter("train.nan_steps").value == 1
+    assert obs.counter("train.skipped_steps").value == 1
+
+    # a good step applies the update and resets the breaker window
+    model.train_batch(good, y)
+    assert guard.consecutive_bad == 0
+    changed = _params(model)
+    assert any(not np.array_equal(after[k], changed[k]) for k in after)
+
+
+def test_step_guard_circuit_breaker_aborts_with_diagnostic():
+    model, _ = _build()
+    model._step_guard = StepGuard(max_consecutive_bad=2)
+    bad = paddle.to_tensor(np.full((4, 4), np.inf, "f4"))
+    y = paddle.to_tensor(np.zeros((4, 2), "f4"))
+    model.train_batch(bad, y)                      # bad #1: skipped
+    with pytest.raises(NonFiniteStepError) as ei:  # bad #2: abort
+        model.train_batch(bad, y)
+    msg = str(ei.value)
+    assert "2 consecutive" in msg and "garbage" in msg
+    assert obs.counter("train.nan_steps").value == 2
+
+
+def test_step_guard_checks_grads_when_asked():
+    model, _ = _build()
+    guard = StepGuard(max_consecutive_bad=5, check_grads=True)
+    x = paddle.to_tensor(np.random.randn(4, 4).astype("f4"))
+    y = paddle.to_tensor(np.zeros((4, 2), "f4"))
+    # materialize grads, then poison ONE grad while the loss is finite
+    model.train_batch(x, y)
+    loss = nn.MSELoss()(model.network(x), y)
+    loss.backward()
+    p = model.network.parameters()[0]
+    import jax.numpy as jnp
+    p.grad._assign_array(jnp.full(p.grad._data.shape, jnp.inf,
+                                  p.grad._data.dtype))
+    assert not guard.pre_step(loss, model._optimizer)
+    assert guard.nan_steps == 1
+
+
+def test_step_guard_is_amp_scaler_aware():
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    scaler = GradScaler(enable=True, init_loss_scaling=8.0)
+    guard = StepGuard(max_consecutive_bad=2)
+    x = paddle.to_tensor(np.random.randn(4, 4).astype("f4"))
+
+    loss = net(x).mean()
+    scaler.scale(loss).backward()
+    import jax.numpy as jnp
+    p = net.parameters()[0]
+    p.grad._assign_array(jnp.full(p.grad._data.shape, jnp.inf,
+                                  p.grad._data.dtype))
+    scaler.step(opt)                      # scaler skips the update
+    assert scaler.last_step_skipped()
+    assert not guard.observe_scaler(scaler)
+    # scaler-managed skip: counted as skipped, NOT as a NaN detection
+    assert guard.skipped_steps == 1 and guard.nan_steps == 0
+    assert obs.counter("train.skipped_steps").value == 1
+    scaler.update()
+    opt.clear_grad()
+
+    # a clean scaled step resets the breaker window
+    loss = net(x).mean()
+    scaler.scale(loss).backward()
+    scaler.step(opt)
+    assert not scaler.last_step_skipped()
+    assert guard.observe_scaler(scaler)
+    assert guard.consecutive_bad == 0
+
+
+# ---------------------------------------------------------- hang detection
+@pytest.mark.chaos
+def test_step_watchdog_aborts_hung_step():
+    _arm()
+    _chaos.install("train.step", kind="slow", seconds=5.0, times=1)
+    model, _ = _build()
+    wd = TrainStepWatchdog(timeout_s=0.2, interval_s=0.03)
+    model._watchdog = wd
+    x = paddle.to_tensor(np.random.randn(4, 4).astype("f4"))
+    y = paddle.to_tensor(np.zeros((4, 2), "f4"))
+    t0 = time.perf_counter()
+    try:
+        with pytest.raises(TrainHangError, match="stalled"):
+            model.train_batch(x, y)
+    finally:
+        wd.stop()
+    # the abort is PROMPT (the 5s injected stall was interrupted),
+    # and never a silent hang
+    assert time.perf_counter() - t0 < 3.0
+    assert wd.tripped
+    assert obs.counter("train.hang_aborts").value == 1
+
+
+def test_step_watchdog_names_stragglers(tmp_path):
+    """Cross-rank attribution: rank 0 publishes progress, rank 1 never
+    does — the report must name rank 1, and the cataloged metrics must
+    carry the trip (satellite: no print-only watchdog)."""
+    wd = TrainStepWatchdog(timeout_s=0.1, interval_s=0.02,
+                           store=FileKVStore(str(tmp_path)), rank=0,
+                           world_size=2, on_timeout=lambda w: None)
+    try:
+        wd.step_begin(step=3)
+        deadline = time.time() + 5
+        while time.time() < deadline and not wd.tripped:
+            time.sleep(0.02)
+        assert wd.tripped
+        assert wd.stragglers == [1]
+        err = wd.hang_error()
+        assert "straggler" in str(err) and err.stragglers == [1]
+        assert obs.counter("train.hang_aborts").value == 1
+        assert obs.gauge("train.straggler_ranks").value == 1
+    finally:
+        wd.stop()
+
+
+def test_watchdog_abort_token_consumed_exactly_once():
+    """Hang translation keys on the abort TOKEN, not trip state: no
+    token ⇒ a KeyboardInterrupt is a genuine ctrl-C and propagates;
+    a sent token translates exactly once even after a re-arm cleared
+    the trip flags."""
+    wd = TrainStepWatchdog(timeout_s=9.0, interval_s=0.05,
+                           on_timeout=lambda w: None)
+    assert wd.consume_abort() is None
+    wd._abort_error = wd.hang_error()
+    wd._abort_sent_at = time.monotonic()
+    wd.step_begin(1)          # re-arm clears tripped, NOT the token
+    wd.step_end()
+    err = wd.consume_abort()
+    assert isinstance(err, TrainHangError)
+    assert wd.consume_abort() is None
+    wd.stop()
+
+
+def test_watchdog_monitor_hibernates_when_idle_and_rearms():
+    """A finished run must not leak a polling thread — the monitor
+    hibernates after the idle budget and a later arm restarts it."""
+    wd = TrainStepWatchdog(timeout_s=5.0, interval_s=0.01,
+                           on_timeout=lambda w: None)
+    try:
+        wd.step_begin(0)
+        wd.step_end()
+        deadline = time.time() + 5
+        while time.time() < deadline and wd._thread is not None:
+            time.sleep(0.02)
+        assert wd._thread is None
+        wd.step_begin(1)
+        assert wd._thread is not None
+        wd.step_end()
+    finally:
+        wd.stop()
+
+
+def test_watchdog_rearm_after_trip_is_monitored():
+    """A supervised restart re-arms right after an abort; the new arm
+    must get a live monitor (the dying thread's slot is released
+    before the abort fires), proven by a second trip."""
+    wd = TrainStepWatchdog(timeout_s=0.05, interval_s=0.01,
+                           on_timeout=lambda w: None)
+    try:
+        wd.step_begin(0)
+        deadline = time.time() + 5
+        while time.time() < deadline and not wd.tripped:
+            time.sleep(0.01)
+        assert wd.tripped
+        wd.step_end()
+        wd.step_begin(1)             # clears tripped, spawns monitor
+        deadline = time.time() + 5
+        while time.time() < deadline and not wd.tripped:
+            time.sleep(0.01)
+        assert wd.tripped            # the new arm IS monitored
+        wd.step_end()
+    finally:
+        wd.stop()
+
+
+def test_watchdog_default_abort_refused_off_main_thread():
+    """CPython delivers KeyboardInterrupt only in the main thread: the
+    default abort armed from a worker thread could neither stop the
+    hung step nor spare unrelated main-thread work — refused up front
+    unless an on_timeout abort channel is supplied."""
+    import threading as _th
+
+    wd = TrainStepWatchdog(timeout_s=9.0, interval_s=0.5)
+    errs = []
+
+    def worker():
+        try:
+            wd.step_begin(0)
+        except RuntimeError as e:
+            errs.append(e)
+
+    t = _th.Thread(target=worker)
+    t.start()
+    t.join()
+    assert errs and "on_timeout" in str(errs[0])
+    wd.stop()
+
+    # with an abort channel, worker-thread arming is fine
+    wd2 = TrainStepWatchdog(timeout_s=9.0, interval_s=0.5,
+                            on_timeout=lambda w: None)
+    ok = []
+    t2 = _th.Thread(target=lambda: ok.append(wd2.step_begin(1)))
+    t2.start()
+    t2.join()
+    assert ok
+    wd2.step_end()
+    wd2.stop()
+
+
+def test_dataloader_seed_refused_with_external_sampler():
+    """seed= only governs the loader-built sampler; pairing it with an
+    external batch_sampler would record a seed the ordering never used
+    and let a resume silently fast-forward the wrong permutation."""
+    from paddle_tpu.io import BatchSampler
+    ds = _RecData()
+    with pytest.raises(ValueError, match="external"):
+        pio.DataLoader(ds, batch_sampler=BatchSampler(
+            ds, shuffle=True, batch_size=4), seed=7)
+
+
+def test_hang_report_flags_wedged_collective(tmp_path):
+    """When every rank's heartbeat predates the armed step and none
+    lags the rest, the whole job blocked at one step — the report must
+    suspect a wedged collective, not blame the local pipeline."""
+    import json as _json
+
+    store = FileKVStore(str(tmp_path))
+    old = time.time() - 5.0
+    store.put("watchdog/default/1", _json.dumps({"ts": old, "ops": 7}))
+    wd = TrainStepWatchdog(timeout_s=0.3, interval_s=0.05, store=store,
+                           rank=0, world_size=2,
+                           on_timeout=lambda w: None)
+    try:
+        wd.step_begin(0)
+        time.sleep(0.1)        # let the arm-time publish land...
+        store.put("watchdog/default/0",
+                  _json.dumps({"ts": old, "ops": 7}))  # ...then stall
+        deadline = time.time() + 5
+        while time.time() < deadline and not wd.tripped:
+            time.sleep(0.02)
+        assert wd.tripped
+        assert wd.stragglers == [] and wd.collective_suspect
+        assert "wedged collective" in str(wd.hang_error())
+    finally:
+        wd.stop()
+
+
+# ------------------------------------------- preemption-safe checkpointing
+class _Sigterm(Callback):
+    """Delivers a REAL SIGTERM to this process mid-training."""
+
+    def __init__(self, at_step):
+        self.at_step = at_step
+
+    def on_train_batch_end(self, step, logs=None):
+        if step == self.at_step:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+
+def test_sigterm_flushes_committed_checkpoint_and_stops(tmp_path):
+    root = str(tmp_path / "ck")
+    model, loader = _build()
+    cb = FaultTolerantCheckpoint(root, every_n_steps=0,
+                                 dataloader=loader)
+    hist = model.fit(loader, epochs=1, verbose=0,
+                     callbacks=[_Sigterm(2), cb])
+    # stopped at the step boundary, not at epoch end; the flush is
+    # COMMITTED (loadable), capturing the step we stopped at
+    assert cb.preempted and len(hist["loss"]) == 3
+    latest = dc.latest_committed(root)
+    assert latest is not None and latest.endswith("step_00000003")
+    assert obs.counter("train.preemptions").value == 1
+    # the handler was restored: SIGTERM dispositions don't leak
+    assert signal.getsignal(signal.SIGTERM) is not \
+        cb._handler._on_signal
+
+
+def test_preempted_callback_is_reusable_for_the_resume_fit(tmp_path):
+    """The natural resume-retry pattern — call fit again with the SAME
+    callback instance — must work: a consumed preemption notice
+    (stopped/preempted/handler.triggered) is reset per fit, and the
+    second fit resumes from the flush and runs to completion."""
+    root = str(tmp_path / "ck")
+    model, loader = _build()
+    cb = FaultTolerantCheckpoint(root, every_n_steps=0,
+                                 dataloader=loader)
+    h1 = model.fit(loader, epochs=1, verbose=0,
+                   callbacks=[_Sigterm(2), cb])
+    assert cb.preempted and len(h1["loss"]) == 3
+
+    model2, loader2 = _build()
+    cb.dataloader = loader2
+    h2 = model2.fit(loader2, epochs=1, verbose=0, callbacks=[cb])
+    # resumed at step 3 and finished the epoch — NOT stopped after one
+    # batch by the stale notice
+    assert not cb.preempted
+    assert len(h2["loss"]) == 5
+    assert cb.global_step == 8
+
+
+def test_preemption_handler_restores_disposition():
+    old = signal.getsignal(signal.SIGTERM)
+    with PreemptionHandler() as h:
+        assert h.installed
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(0.01)
+        assert h.triggered
+    assert signal.getsignal(signal.SIGTERM) is old
+
+
+@pytest.mark.chaos
+def test_chaos_preempt_site_drives_the_flush_path(tmp_path):
+    """train.preempt chaos: an injected error at the step boundary is
+    a delivered preemption notice — same flush-and-stop path, no real
+    signal needed."""
+    _arm()
+    _chaos.install("train.preempt", kind="error", times=1,
+                   match=lambda c: c.get("step") == 2)
+    root = str(tmp_path / "ck")
+    model, loader = _build()
+    cb = FaultTolerantCheckpoint(root, every_n_steps=0,
+                                 dataloader=loader)
+    hist = model.fit(loader, epochs=1, verbose=0, callbacks=[cb])
+    assert cb.preempted and len(hist["loss"]) == 2
+    assert dc.latest_committed(root).endswith("step_00000002")
+
+
+@pytest.mark.chaos
+def test_checkpoint_save_chaos_leaves_dir_uncommitted(tmp_path):
+    """A writer killed mid-save (train.checkpoint_save fires after the
+    stale marker drop) must leave an UNCOMMITTED dir that resume
+    skips — the commit protocol's whole point."""
+    model, loader = _build()
+    root = str(tmp_path)
+    save_train_checkpoint(root, 1, model.network, model._optimizer,
+                          loader)
+    _arm()
+    _chaos.install("train.checkpoint_save", kind="error", times=1)
+    with pytest.raises(_chaos.ChaosError):
+        save_train_checkpoint(root, 2, model.network,
+                              model._optimizer, loader)
+    latest = dc.latest_committed(root)
+    assert latest is not None and latest.endswith("step_00000001")
+    assert not dc.is_committed(os.path.join(root, "step_00000002"))
+    assert obs.counter("train.checkpoint_saves").value == 1
+
+
+# --------------------------------------------------- dataloader position
+def test_dataloader_state_roundtrip_replays_exact_order():
+    _SERVED.clear()
+    full = pio.DataLoader(_RecData(), batch_size=4, shuffle=True,
+                          seed=11)
+    list(full)
+    oracle = list(_SERVED)
+
+    _SERVED.clear()
+    first = pio.DataLoader(_RecData(), batch_size=4, shuffle=True,
+                           seed=11)
+    it = iter(first)
+    for _ in range(3):
+        next(it)
+    state = first.state_dict()
+    assert state["batches_served"] == 3 and state["seed"] == 11
+
+    resumed = pio.DataLoader(_RecData(), batch_size=4, shuffle=True,
+                             seed=11)
+    resumed.set_state_dict(state)
+    list(resumed)
+    # fast-forward: the skipped batches were NOT re-loaded, and the
+    # consumed order equals the uninterrupted pass exactly
+    assert _SERVED == oracle
+    # next epoch reshuffles (position rolled over)
+    assert resumed.state_dict() == {"epoch": 1, "batches_served": 0,
+                                    "seed": 11}
+
+
+def test_dataloader_resume_refuses_seed_mismatch():
+    dl = pio.DataLoader(_RecData(), batch_size=4, shuffle=True, seed=1)
+    with pytest.raises(ValueError, match="seed"):
+        dl.set_state_dict({"epoch": 0, "batches_served": 2, "seed": 9})
+    # BOTH directions: an unseeded checkpoint into a seeded loader
+    # would fast-forward an unrelated permutation — refuse, don't
+    # silently corrupt the data order
+    with pytest.raises(ValueError, match="seed"):
+        dl.set_state_dict({"epoch": 0, "batches_served": 2,
+                           "seed": None})
+
+
+def test_prefetch_worker_exits_when_iterator_abandoned():
+    """Mid-epoch abandonment (preemption / crash under run_resilient)
+    must unwind the background prefetch worker — a thread blocked on
+    a full queue forever would leak once per crashed attempt."""
+    import threading as _th
+
+    before = set(_th.enumerate())
+    dl = pio.DataLoader(_RecData(), batch_size=2, num_workers=1,
+                        prefetch_factor=1)
+    for _ in range(2):                    # repeated abandonment
+        it = iter(dl)
+        next(it)
+        it.close()
+    deadline = time.time() + 5
+    while time.time() < deadline and (set(_th.enumerate()) - before):
+        time.sleep(0.05)
+    leaked = set(_th.enumerate()) - before
+    assert not leaked, leaked
+
+
+# --------------------------------------------- crash-resume (acceptance)
+@pytest.mark.chaos
+def test_resume_equivalence_bitwise(tmp_path):
+    """THE acceptance drill: train 8 steps uninterrupted vs train 4 +
+    chaos-kill + run_resilient resume 4 — bitwise-identical parameters
+    AND identical consumed data order."""
+    _SERVED.clear()
+    model, loader = _build()
+    model.fit(loader, epochs=1, verbose=0)
+    oracle = _params(model)
+    oracle_order = list(_SERVED)
+    assert len(oracle_order) == 32                 # 8 batches of 4
+
+    _SERVED.clear()
+    _arm()
+    _chaos.install("train.step", kind="error", times=1,
+                   match=lambda c: c.get("step") == 4)
+    root = str(tmp_path / "ck")
+    out = {}
+    restarts = []
+
+    def worker(attempt):
+        m, dl = _build()
+        cb = FaultTolerantCheckpoint(root, every_n_steps=1,
+                                     dataloader=dl)
+        m.fit(dl, epochs=1, verbose=0, callbacks=[cb])
+        out["model"], out["cb"] = m, cb
+
+    run_resilient(worker, max_restarts=2, backoff_s=0.01,
+                  on_restart=lambda a, e: restarts.append((a, e)))
+    assert len(restarts) == 1
+    assert isinstance(restarts[0][1], _chaos.ChaosError)
+    assert out["cb"].resumed_from.endswith("step_00000004")
+    assert obs.counter("train.restarts").value == 1
+
+    resumed = _params(out["model"])
+    for k in oracle:
+        assert oracle[k].tobytes() == resumed[k].tobytes(), k
+    # data order: attempt 1 consumed batches 0..4 (batch 4's step
+    # crashed), the resume fast-forwarded WITHOUT reloading 0..3 and
+    # replayed exactly batches 4..7
+    assert _SERVED == oracle_order[:20] + oracle_order[16:]
+
+
+@pytest.mark.chaos
+def test_resume_equivalence_across_epochs(tmp_path):
+    """Multi-epoch resume: a crash in epoch 1 of 2 must NOT re-run
+    epoch 0 — the fit epoch budget carries across the restart (via the
+    checkpointed fit epoch) and the resumed run still matches the
+    uninterrupted 2-epoch run bitwise."""
+    _SERVED.clear()
+    model, loader = _build()
+    model.fit(loader, epochs=2, verbose=0)
+    oracle = _params(model)
+    oracle_order = list(_SERVED)
+    assert len(oracle_order) == 64
+
+    _SERVED.clear()
+    _arm()
+    _chaos.install("train.step", kind="error", times=1,
+                   match=lambda c: c.get("step") == 10)  # epoch 1, #2
+    root = str(tmp_path / "ck")
+    out = {}
+
+    def worker(attempt):
+        m, dl = _build()
+        cb = FaultTolerantCheckpoint(root, every_n_steps=1,
+                                     dataloader=dl)
+        m.fit(dl, epochs=2, verbose=0, callbacks=[cb])
+        out["m"] = m
+
+    run_resilient(worker, max_restarts=2, backoff_s=0.01)
+    resumed = _params(out["m"])
+    for k in oracle:
+        assert oracle[k].tobytes() == resumed[k].tobytes(), k
+    # attempt 1 consumed epoch 0 + epoch-1 batches 0..2 (the crashed
+    # fetch); the resume replayed ONLY epoch-1 batches 2..7 — epoch 0
+    # was not re-trained
+    assert _SERVED == oracle_order[:44] + oracle_order[40:]
+
+
+@pytest.mark.chaos
+def test_resume_at_epoch_boundary_does_not_replay_epoch_end(tmp_path):
+    """A checkpoint flushed at an epoch's final batch must resume at
+    the NEXT epoch's start: re-entering the finished epoch would fire
+    on_epoch_end (and eval) a second time — double-stepping epoch-wise
+    LR schedulers and double-counting early-stop patience."""
+    epoch_ends = []
+
+    class _Track(Callback):
+        def on_epoch_end(self, epoch, logs=None):
+            epoch_ends.append(epoch)
+
+    _SERVED.clear()
+    model, loader = _build()
+    model.fit(loader, epochs=2, verbose=0)
+    oracle = _params(model)
+
+    _arm()
+    # crash on epoch 1's FIRST step: the latest committed flush is the
+    # epoch-0-final-batch checkpoint
+    _chaos.install("train.step", kind="error", times=1,
+                   match=lambda c: c.get("step") == 8)
+    root = str(tmp_path / "ck")
+    out = {}
+
+    def worker(attempt):
+        m, dl = _build()
+        cb = FaultTolerantCheckpoint(root, every_n_steps=1,
+                                     dataloader=dl)
+        m.fit(dl, epochs=2, verbose=0, callbacks=[_Track(), cb])
+        out["m"] = m
+
+    run_resilient(worker, max_restarts=2, backoff_s=0.01)
+    resumed = _params(out["m"])
+    for k in oracle:
+        assert oracle[k].tobytes() == resumed[k].tobytes(), k
+    # attempt 1 ended epoch 0 once; the resume ran ONLY epoch 1 —
+    # epoch 0's end-of-epoch hooks never replayed
+    assert epoch_ends == [0, 1]
+
+
+@pytest.mark.chaos
+def test_crashed_fit_still_restores_sigterm_handler(tmp_path):
+    """on_train_end runs even when an attempt crashes mid-loop: the
+    crashed attempt's SIGTERM handler must not stay installed (a stale
+    handler on a dead callback would swallow the NEXT attempt's
+    preemption notice)."""
+    old = signal.getsignal(signal.SIGTERM)
+    _arm()
+    _chaos.install("train.step", kind="error", times=1)
+    model, loader = _build()
+    cb = FaultTolerantCheckpoint(str(tmp_path / "ck"), every_n_steps=1,
+                                 dataloader=loader)
+    with pytest.raises(_chaos.ChaosError):
+        model.fit(loader, epochs=1, verbose=0, callbacks=[cb])
+    assert signal.getsignal(signal.SIGTERM) == old
+
+
+def test_watchdog_trip_state_clears_on_rearm():
+    """A stale tripped flag would rebrand a later genuine ctrl-C as a
+    TrainHangError — re-arming must clear the previous trip."""
+    wd = TrainStepWatchdog(timeout_s=0.05, interval_s=0.01,
+                           on_timeout=lambda w: None)
+    try:
+        wd.step_begin(0)
+        deadline = time.time() + 5
+        while time.time() < deadline and not wd.tripped:
+            time.sleep(0.01)
+        assert wd.tripped
+        wd.step_begin(1)
+        assert not wd.tripped and wd.stragglers is None
+        wd.step_end()
+    finally:
+        wd.stop()
+
+
+def test_resume_restores_lazy_optimizer_accumulators(tmp_path):
+    """Adam moments et al. are created lazily on the first step(); a
+    FRESH optimizer's resume must still restore them (the load forces
+    accumulator creation before building the template) — without this,
+    a stateful-optimizer resume silently drops its moments."""
+    paddle.seed(1)
+    net = nn.Linear(4, 2)
+    opt = paddle.optimizer.AdamW(1e-2, parameters=net.parameters())
+    x = paddle.to_tensor(np.random.randn(4, 4).astype("f4"))
+    for _ in range(2):
+        net(x).mean().backward()
+        opt.step()
+        opt.clear_grad()
+    save_train_checkpoint(str(tmp_path), 2, net, opt)
+
+    paddle.seed(1)
+    net2 = nn.Linear(4, 2)
+    opt2 = paddle.optimizer.AdamW(1e-2, parameters=net2.parameters())
+    assert not opt2._accumulators       # fresh: nothing created yet
+    meta = load_train_checkpoint(str(tmp_path), net2, opt2)
+    assert meta["step"] == 2
+    sd1, sd2 = opt.state_dict(), opt2.state_dict()
+    assert set(sd1) == set(sd2)
+    for k, v in sd1.items():
+        if hasattr(v, "numpy"):
+            np.testing.assert_array_equal(v.numpy(), sd2[k].numpy(), k)
+
+
+def test_resume_restores_lr_scheduler_and_global_step(tmp_path):
+    """Optimizer PYTHON state — the LR schedule position and
+    global_step — must survive resume too: tensors restore in place,
+    but these only round-trip if the load hands them back via
+    set_state_dict (a scheduled-LR resume that silently restarts its
+    schedule trains at the wrong LR)."""
+    paddle.seed(2)
+    net = nn.Linear(4, 2)
+    sched = paddle.optimizer.lr.StepDecay(0.1, step_size=2)
+    opt = paddle.optimizer.SGD(sched, parameters=net.parameters())
+    x = paddle.to_tensor(np.random.randn(4, 4).astype("f4"))
+    for _ in range(6):
+        net(x).mean().backward()
+        opt.step()
+        opt.clear_grad()
+        sched.step()
+    want_lr, want_gs = sched(), opt._global_step
+    assert want_lr < 0.1                    # schedule actually moved
+    save_train_checkpoint(str(tmp_path), 6, net, opt)
+
+    paddle.seed(2)
+    net2 = nn.Linear(4, 2)
+    sched2 = paddle.optimizer.lr.StepDecay(0.1, step_size=2)
+    opt2 = paddle.optimizer.SGD(sched2, parameters=net2.parameters())
+    load_train_checkpoint(str(tmp_path), net2, opt2)
+    assert sched2() == want_lr
+    assert sched2.last_epoch == sched.last_epoch
+    assert opt2._global_step == want_gs
+
+
+def test_run_resilient_bounded_retries_with_backoff():
+    calls = []
+
+    def always_fails(attempt):
+        calls.append(attempt)
+        raise RuntimeError("boom")
+
+    t0 = time.perf_counter()
+    with pytest.raises(RuntimeError, match="boom"):
+        run_resilient(always_fails, max_restarts=2, backoff_s=0.02,
+                      backoff_factor=2.0)
+    # attempts 0,1,2 ran; backoff 0.02 + 0.04 elapsed between them
+    assert calls == [0, 1, 2]
+    assert time.perf_counter() - t0 >= 0.06
+    assert obs.counter("train.restarts").value == 2
+
+    # KeyboardInterrupt always propagates without a restart
+    def ctrl_c(attempt):
+        calls.append("kbd")
+        raise KeyboardInterrupt()
+
+    with pytest.raises(KeyboardInterrupt):
+        run_resilient(ctrl_c, max_restarts=5, backoff_s=0)
+    assert calls.count("kbd") == 1
+
+
+# --------------------------------------------------------- chaos parsing
+@pytest.mark.chaos
+def test_chaos_env_spec_training_sites_roundtrip():
+    """Env-spec round-trip for the ISSUE 15 hook sites: dotted train.*
+    site names parse, budgets and slow-seconds apply, and the clause
+    list maps 1:1 onto installed rules."""
+    spec = ("train.step:error:2;train.data_fetch:slow:0.05;"
+            "train.checkpoint_save:alloc:1;train.preempt:error:1")
+    os.environ[_chaos.ENV] = spec
+    with pytest.raises(_chaos.ChaosError):
+        _chaos.hit("train.step")
+    rules = [r for r in _chaos._rules if r.from_env]
+    assert sorted(r.site for r in rules) == [
+        "train.checkpoint_save", "train.data_fetch", "train.preempt",
+        "train.step"]
+    kinds = {r.site: r.kind for r in rules}
+    assert kinds["train.data_fetch"] == "slow"
+    assert kinds["train.checkpoint_save"] == "alloc"
+    with pytest.raises(_chaos.ChaosError):
+        _chaos.hit("train.step")
+    _chaos.hit("train.step")                       # budget of 2 spent
+    t0 = time.perf_counter()
+    _chaos.hit("train.data_fetch")                 # slow, not an error
+    assert time.perf_counter() - t0 >= 0.04
+    with pytest.raises(_chaos.ChaosAllocError):
+        _chaos.hit("train.checkpoint_save")
+    _chaos.hit("train.checkpoint_save")            # budget of 1 spent
+    with pytest.raises(_chaos.ChaosError):
+        _chaos.hit("train.preempt")
